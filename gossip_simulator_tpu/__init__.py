@@ -1,0 +1,22 @@
+"""TPU-native gossip/epidemic simulation framework.
+
+Capability parity with the reference `go-distributed/gossip_simulator`
+(/root/reference/simulator.go), rebuilt as a single SPMD array program:
+node state is a struct-of-arrays pytree sharded on the node axis; one
+simulated millisecond (or one gossip round) is a jitted step; the simulated
+network is data movement inside the step (gather/scatter in-shard,
+all_to_all over ICI across shards).
+
+Public surface:
+    Config, parse_args        -- typed config, CLI-compatible with the reference
+    make_stepper              -- Stepper factory ("native" | "cpp" | "jax" | "sharded")
+    run_simulation            -- the two-phase driver (overlay build + broadcast)
+"""
+
+from gossip_simulator_tpu.config import Config, parse_args
+from gossip_simulator_tpu.backends import make_stepper
+from gossip_simulator_tpu.driver import run_simulation
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "parse_args", "make_stepper", "run_simulation", "__version__"]

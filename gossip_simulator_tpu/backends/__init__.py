@@ -1,0 +1,31 @@
+"""Backend registry (the Stepper seam -- see base.py)."""
+
+from __future__ import annotations
+
+from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
+from gossip_simulator_tpu.config import Config
+
+
+def make_stepper(cfg: Config) -> Stepper:
+    """Factory: `-backend` flag -> Stepper implementation (lazy imports keep
+    e.g. the native oracle importable without touching jax)."""
+    if cfg.backend == "native":
+        from gossip_simulator_tpu.backends.native import NativeStepper
+
+        return NativeStepper(cfg)
+    if cfg.backend == "cpp":
+        from gossip_simulator_tpu.backends.cpp import CppStepper
+
+        return CppStepper(cfg)
+    if cfg.backend == "jax":
+        from gossip_simulator_tpu.backends.jax_backend import JaxStepper
+
+        return JaxStepper(cfg)
+    if cfg.backend == "sharded":
+        from gossip_simulator_tpu.backends.sharded import ShardedStepper
+
+        return ShardedStepper(cfg)
+    raise ValueError(f"unknown backend {cfg.backend!r}")
+
+
+__all__ = ["Stepper", "make_stepper", "WINDOW_MS"]

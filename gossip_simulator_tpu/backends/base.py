@@ -1,0 +1,74 @@
+"""The Stepper backend seam.
+
+BASELINE.json's north star asks for the per-round node-update loop behind a
+``Stepper`` interface (Init/Step/Stats) so backends are swappable:
+
+* ``native``  -- event-driven Python oracle, faithful to the reference's
+                 goroutine/channel semantics in *simulated* time (small N).
+* ``cpp``     -- the same discrete-event algorithm in C++ (ctypes), the fast
+                 CPU baseline standing in for the reference's Go loop.
+* ``jax``     -- vectorized single-device XLA program (the product).
+* ``sharded`` -- jax over a `jax.sharding.Mesh`, cross-shard all_to_all.
+
+One ``gossip_window()`` call advances 10 simulated milliseconds -- the
+reference driver's poll cadence (simulator.go:223,244) -- or one round in
+rounds mode, so the driver's printing loop is backend-agnostic.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.utils.metrics import Stats
+
+WINDOW_MS = 10  # reference poll interval (simulator.go:223, 244)
+
+
+class Stepper(abc.ABC):
+    name: str = "abstract"
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+
+    # --- lifecycle ------------------------------------------------------------
+    @abc.abstractmethod
+    def init(self) -> None:
+        """Allocate node state (mirrors simulator.go:207-217)."""
+
+    @abc.abstractmethod
+    def overlay_window(self) -> tuple[int, int, bool]:
+        """Advance overlay construction by one poll window.
+
+        Returns ``(makeups, breakups, quiesced)`` -- the membership events
+        observed during the window and whether the system has stabilized
+        (no makeup/breakup activity for a full window, simulator.go:221-234).
+        For static graphs ("kout", "erdos", "ring") the first call generates
+        the graph and returns quiesced immediately.
+        """
+
+    @abc.abstractmethod
+    def seed(self) -> None:
+        """Pick a uniform-random node and inject its initial broadcast
+        (simulator.go:240-241)."""
+
+    @abc.abstractmethod
+    def gossip_window(self) -> Stats:
+        """Advance the epidemic by one poll window (10 simulated ms in ticks
+        mode; one round in rounds mode) and return a counters snapshot."""
+
+    @abc.abstractmethod
+    def stats(self) -> Stats:
+        """Current counters snapshot (host-side)."""
+
+    @abc.abstractmethod
+    def sim_time_ms(self) -> float:
+        """Simulated milliseconds elapsed in the current phase."""
+
+    # --- optional -------------------------------------------------------------
+    def state_pytree(self):
+        """Backend state as arrays for checkpointing; None if unsupported."""
+        return None
+
+    def load_state_pytree(self, tree) -> None:
+        raise NotImplementedError(f"{self.name} does not support checkpoint restore")

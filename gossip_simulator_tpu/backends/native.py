@@ -1,0 +1,326 @@
+"""Event-driven oracle backend (pure Python/NumPy, small N).
+
+This is the framework's ground truth: a discrete-event reimplementation of the
+reference's behavioral contract in *simulated* time.  Where the reference
+interleaves goroutines sleeping real wall-clock delays (simulator.go:140-168),
+this backend processes a time-ordered event heap -- same protocol decisions,
+same distributions, but deterministic, seedable, and free of the Go
+scheduler's overhead and races.
+
+Protocol fidelity notes (all against /root/reference/simulator.go):
+* makeup handling  -- accept under fanin else evict uniform-random victim and
+  send it a breakup (simulator.go:66-75).
+* breakup handling -- first-match scan; over fanout -> plain remove, else
+  in-place replace with a fresh random peer (!= self, != leaver) plus a makeup
+  (simulator.go:76-94, 127-138).
+* bootstrap        -- one friend per needNewFriend event, self-collision
+  patched as (id+1)%N, duplicate edges allowed, immediate re-arm
+  (simulator.go:95-106).
+* receive path     -- crashed black-hole (uncounted), count, crash draw,
+  duplicate drop, infect + re-broadcast with ONE shared delay for all fanout
+  sends (simulator.go:107-123, 140-149).
+* crashed nodes keep processing membership traffic; only data messages are
+  black-holed (the crashed check exists only in the recvMsgCh case,
+  simulator.go:108-110).
+
+Documented divergences (config-gated where meaningful, see config.py):
+* Quiescence is race-free: stabilization requires an idle window AND an empty
+  membership event queue, fixing the reference's read-reset race (§5.2 of
+  SURVEY.md) in which in-flight delayed makeups could be missed.
+* Unless ``compat_reference``, the seed node is itself marked received
+  (the reference never marks it, simulator.go:240-241) and Bernoulli draws
+  use exact float probabilities rather than 1% truncation.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List
+
+import numpy as np
+
+from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.utils.metrics import Stats
+
+# Event kinds.
+BOOT, MAKEUP, BREAKUP, MSG, REBROADCAST = 0, 1, 2, 3, 4
+_MEMBERSHIP = (BOOT, MAKEUP, BREAKUP)
+
+
+class NativeStepper(Stepper):
+    name = "native"
+
+    def init(self) -> None:
+        cfg = self.cfg
+        self.rng = np.random.default_rng(cfg.seed)
+        self.n = cfg.n
+        self.friends: List[List[int]] = [[] for _ in range(self.n)]
+        self.received = np.zeros(self.n, dtype=bool)
+        self.crashed = np.zeros(self.n, dtype=bool)
+        self.heap: list = []
+        self._seq = 0
+        self._pending_membership = 0
+        self.now = 0.0
+        self.phase_start = 0.0
+        self.total_message = 0
+        self.total_received = 0
+        self.total_crashed = 0
+        self.makeups = 0
+        self.breakups = 0
+        self._win_makeups = 0
+        self._win_breakups = 0
+        self.exhausted = False
+        # SIR state: a removed node stops forwarding but stays "received".
+        self.removed = np.zeros(self.n, dtype=bool)
+
+        if cfg.graph == "overlay":
+            for i in range(self.n):
+                self._push(0.0, BOOT, i, -1)
+            self._overlay_done = False
+        else:
+            self._generate_static_graph()
+            self._overlay_done = True
+
+    # --- static graphs ---------------------------------------------------------
+    def _generate_static_graph(self) -> None:
+        cfg, rng, n = self.cfg, self.rng, self.n
+        if cfg.graph == "kout":
+            # k-out random digraph: each node picks `fanout` uniform peers
+            # (duplicates allowed, self patched away like simulator.go:98-100).
+            for i in range(n):
+                picks = rng.integers(0, n, size=cfg.fanout)
+                self.friends[i] = [int((p + 1) % n) if p == i else int(p) for p in picks]
+        elif cfg.graph == "erdos":
+            # Sparse directed ER approximation: out-degree ~ Poisson(n*p).
+            lam = cfg.er_p_resolved * n
+            degs = rng.poisson(lam, size=n)
+            for i in range(n):
+                picks = rng.integers(0, n, size=int(degs[i]))
+                self.friends[i] = [int((p + 1) % n) if p == i else int(p) for p in picks]
+        elif cfg.graph == "ring":
+            for i in range(n):
+                self.friends[i] = [(i + j + 1) % n for j in range(cfg.fanout)]
+        else:  # pragma: no cover
+            raise ValueError(cfg.graph)
+
+    # --- event plumbing --------------------------------------------------------
+    def _push(self, t: float, kind: int, dst: int, src: int) -> None:
+        self._seq += 1
+        if kind in _MEMBERSHIP:
+            self._pending_membership += 1
+        heapq.heappush(self.heap, (t, self._seq, kind, dst, src))
+
+    def _delay(self) -> float:
+        if self.cfg.effective_time_mode == "rounds":
+            return 1.0
+        d = int(self.rng.integers(self.cfg.delaylow, self.cfg.delayhigh))
+        return float(max(d, 1))
+
+    def _bern(self, p: float) -> bool:
+        if self.cfg.compat_reference:
+            p = int(p * 100) / 100.0  # simulator.go:172,180 truncation
+        return bool(self.rng.random() < p)
+
+    def _rand_peer_excluding(self, *exclude: int) -> int:
+        while True:
+            r = int(self.rng.integers(0, self.n))
+            if r not in exclude:
+                return r
+
+    # --- protocol handlers -----------------------------------------------------
+    def _handle(self, t: float, kind: int, dst: int, src: int) -> None:
+        if kind in _MEMBERSHIP:
+            self._pending_membership -= 1
+        f = self.friends[dst]
+        if kind == BOOT:
+            if len(f) < self.cfg.fanout:
+                nf = int(self.rng.integers(0, self.n))
+                if nf == dst:
+                    nf = (nf + 1) % self.n
+                f.append(nf)
+                self._push(t + self._delay(), MAKEUP, nf, dst)
+                if len(f) < self.cfg.fanout:
+                    self._push(t, BOOT, dst, -1)
+        elif kind == MAKEUP:
+            self.makeups += 1
+            self._win_makeups += 1
+            if len(f) < self.cfg.fanin_resolved:
+                f.append(src)
+            else:
+                victim_pos = int(self.rng.integers(0, len(f)))
+                self._push(t + self._delay(), BREAKUP, f[victim_pos], dst)
+                f[victim_pos] = src
+        elif kind == BREAKUP:
+            self.breakups += 1
+            self._win_breakups += 1
+            for i, fid in enumerate(f):
+                if fid == src:
+                    if len(f) > self.cfg.fanout:
+                        del f[i]
+                    else:
+                        nf = self._rand_peer_excluding(src, dst)
+                        f[i] = nf
+                        self._push(t + self._delay(), MAKEUP, nf, dst)
+                    break
+        elif kind == MSG:
+            self._receive(t, dst)
+        elif kind == REBROADCAST:
+            # SIR: an infected node keeps spreading every delay interval until
+            # its per-broadcast removal draw fires (no referent in the
+            # reference; BASELINE.json config 4's added capability).
+            if not self.crashed[dst] and not self.removed[dst]:
+                self._broadcast(t, dst)
+
+    def _receive(self, t: float, dst: int) -> None:
+        cfg = self.cfg
+        if self.crashed[dst]:
+            return  # black-hole, uncounted (simulator.go:108-110)
+        self.total_message += 1
+        if self._bern(cfg.crashrate):
+            self.crashed[dst] = True
+            self.total_crashed += 1
+            return
+        if self.received[dst]:
+            return  # duplicate (simulator.go:117-119)
+        self.received[dst] = True
+        self.total_received += 1
+        self._broadcast(t, dst)
+
+    def _broadcast(self, t: float, node: int) -> None:
+        """One shared delay for the whole fan-out; per-link drop draw
+        (simulator.go:140-149)."""
+        d = self._delay()
+        for fid in self.friends[node]:
+            if not self._bern(self.cfg.droprate):
+                self._push(t + d, MSG, fid, node)
+        if self.cfg.protocol == "sir":
+            if self._bern(self.cfg.removal_rate):
+                self.removed[node] = True
+            else:
+                self._push(t + d, REBROADCAST, node, node)
+
+    # --- Stepper API -----------------------------------------------------------
+    def overlay_window(self) -> tuple[int, int, bool]:
+        if self._overlay_done:
+            return 0, 0, True
+        win = WINDOW_MS if self.cfg.effective_time_mode == "ticks" else 1
+        self._win_makeups = self._win_breakups = 0
+        end = self.now + win
+        self._drain(end)
+        self.now = end
+        quiesced = (
+            self._win_makeups == 0
+            and self._win_breakups == 0
+            and self._pending_membership == 0
+        )
+        if quiesced:
+            self._overlay_done = True
+        return self._win_makeups, self._win_breakups, quiesced
+
+    def seed(self) -> None:
+        self.phase_start = self.now
+        sender = int(self.rng.integers(0, self.n))
+        self.seed_node = sender
+        if self.cfg.protocol == "pushpull":
+            # Anti-entropy needs an infected seed; the broadcast machinery is
+            # unused (peers are sampled fresh each round).
+            self.received[sender] = True
+            self.total_received += 1
+            return
+        if not self.cfg.compat_reference:
+            self.received[sender] = True
+            self.total_received += 1
+        self._broadcast(self.now, sender)
+
+    def gossip_window(self) -> Stats:
+        if self.cfg.protocol == "pushpull":
+            self._pushpull_round()
+            self.now += 1
+            return self.stats()
+        win = WINDOW_MS if self.cfg.effective_time_mode == "ticks" else 1
+        end = self.now + win
+        self._drain(end)
+        self.now = end
+        self.exhausted = not self.heap
+        return self.stats()
+
+    def _drain(self, end: float) -> None:
+        heap = self.heap
+        while heap and heap[0][0] < end:
+            t, _, kind, dst, src = heapq.heappop(heap)
+            self._handle(t, kind, dst, src)
+
+    def _pushpull_round(self) -> None:
+        """One synchronous push-pull anti-entropy round: every live node
+        contacts `fanout` uniform random peers; infection crosses each
+        surviving contact in both directions.  (No referent in the reference --
+        BASELINE.json config 3's added capability.)  Per-contact drop draw;
+        crash draw on push receptions only."""
+        cfg, rng = self.cfg, self.rng
+        live = ~self.crashed
+        inf = self.received & live
+        sus = ~self.received & live
+        # Push: infected -> random peers.
+        pushers = np.flatnonzero(inf)
+        if pushers.size:
+            peers = rng.integers(0, self.n, size=(pushers.size, cfg.fanout))
+            kept = rng.random(peers.shape) >= self._p_eff(cfg.droprate)
+            tgt = peers[kept]
+            alive_tgt = tgt[~self.crashed[tgt]]
+            self.total_message += int(alive_tgt.size)
+            crash = rng.random(alive_tgt.size) < self._p_eff(cfg.crashrate)
+            newly_crashed = np.unique(alive_tgt[crash])
+            newly_crashed = newly_crashed[~self.crashed[newly_crashed]]
+            self.crashed[newly_crashed] = True
+            self.total_crashed += int(newly_crashed.size)
+            ok = alive_tgt[~crash]
+            ok = ok[~self.crashed[ok] & ~self.received[ok]]
+            newly = np.unique(ok)
+            self.received[newly] = True
+            self.total_received += int(newly.size)
+        # Pull: susceptible <- random peers' state.
+        pullers = np.flatnonzero(sus & ~self.received)
+        if pullers.size:
+            peers = rng.integers(0, self.n, size=(pullers.size, cfg.fanout))
+            kept = rng.random(peers.shape) >= self._p_eff(cfg.droprate)
+            live_contact = kept & ~self.crashed[peers]
+            hit = (self.received[peers] & live_contact).any(axis=1)
+            newly = pullers[hit]
+            self.received[newly] = True
+            self.total_received += int(newly.size)
+            # Count only responses from live peers (a crashed peer black-holes
+            # the request, matching the push path's accounting).
+            self.total_message += int(live_contact.sum())
+
+    def _p_eff(self, p: float) -> float:
+        return int(p * 100) / 100.0 if self.cfg.compat_reference else p
+
+    def stats(self) -> Stats:
+        return Stats(
+            n=self.n,
+            round=int(self.now - self.phase_start),
+            total_received=self.total_received,
+            total_message=self.total_message,
+            total_crashed=self.total_crashed,
+            makeups=self.makeups,
+            breakups=self.breakups,
+        )
+
+    def sim_time_ms(self) -> float:
+        return self.now - self.phase_start
+
+    # --- checkpointing ---------------------------------------------------------
+    def state_pytree(self):
+        deg = np.array([len(f) for f in self.friends], dtype=np.int32)
+        cap = max(int(deg.max(initial=0)), 1)
+        fr = np.full((self.n, cap), -1, dtype=np.int32)
+        for i, f in enumerate(self.friends):
+            fr[i, : len(f)] = f
+        return {
+            "received": self.received.copy(),
+            "crashed": self.crashed.copy(),
+            "removed": self.removed.copy(),
+            "friends": fr,
+            "friend_cnt": deg,
+        }

@@ -1,0 +1,22 @@
+"""CLI entry point, flag-compatible with the reference
+(``go run simulator.go -n 50000 -fanout 5 ...`` -> ``python -m
+gossip_simulator_tpu -n 50000 -fanout 5 ...``; see config.py for the flag
+table and divergence notes)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from gossip_simulator_tpu.config import parse_args
+from gossip_simulator_tpu.driver import run_simulation
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    cfg = parse_args(argv)
+    result = run_simulation(cfg)
+    return 0 if result.converged else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,247 @@
+"""Typed configuration for the TPU-native gossip simulator.
+
+Mirrors the reference CLI flag-for-flag (reference: simulator.go:186-205) and adds
+the knobs the TPU framework needs (`backend`, `protocol`, `graph`, `seed`,
+`max_rounds`, ...).  Documented divergences from the reference:
+
+* ``fanin`` defaults to *resolved* ``fanout + 1``.  The reference evaluates
+  ``Fanout+1`` at flag-registration time, so its fanin default is the constant 6
+  regardless of ``-fanout`` (simulator.go:189).  ``compat_reference=True``
+  restores the constant-6 behaviour.
+* Drop/crash probabilities are exact float Bernoulli draws.  The reference
+  truncates to 1% resolution via ``rand.Intn(100) < int(rate*100)``
+  (simulator.go:172,180), so its default ``crashrate=0.001`` can never crash.
+  ``compat_reference=True`` restores the truncation.
+* ``DelayHigh <= DelayLow`` is a validation error here; the reference panics in
+  ``rand.Intn`` (simulator.go:167).
+* ``max_rounds`` bounds the epidemic phase; the reference spins forever if 99%
+  is unreachable (simulator.go:243-251).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import math
+from typing import Optional
+
+BACKENDS = ("native", "cpp", "jax", "sharded")
+PROTOCOLS = ("si", "pushpull", "sir")
+GRAPHS = ("overlay", "kout", "erdos", "ring")
+TIME_MODES = ("ticks", "rounds")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Full simulation configuration.
+
+    The first seven fields correspond 1:1 to the reference flags
+    (simulator.go:187-193, defaults preserved).
+    """
+
+    n: int = 50_000
+    fanout: int = 5
+    fanin: int = -1  # -1 -> resolved fanout + 1 (see module docstring)
+    delaylow: int = 10  # ms (one simulated tick == 1 ms)
+    delayhigh: int = 20  # ms, exclusive upper bound like rand.Intn
+    droprate: float = 0.1
+    crashrate: float = 0.001
+
+    # --- framework extensions -------------------------------------------------
+    backend: str = "native"  # TODO(round 1): flip to "jax" once jax_backend lands
+    protocol: str = "si"
+    graph: str = "overlay"
+    seed: int = 0
+    max_rounds: int = 100_000
+    coverage_target: float = 0.99  # reference stops at >=99% (simulator.go:248)
+    # "ticks": 1 round == 1 simulated ms; messages carry uniform[delaylow,
+    # delayhigh) delivery delays through a ring buffer (faithful to the
+    # reference's time-to-99% semantics).  "rounds": synchronous rounds, one
+    # hop per round (classic epidemic-rounds accounting; faster).
+    time_mode: str = "ticks"
+    # SIR removal probability (config 4 in BASELINE.json); ignored otherwise.
+    removal_rate: float = 0.1
+    # Erdos-Renyi edge probability; -1 -> fanout/n (expected degree == fanout).
+    er_p: float = -1.0
+    # Reproduce reference quirks (1%-resolution Bernoulli, constant fanin
+    # default, seed node never counted as received: simulator.go:240-241).
+    compat_reference: bool = False
+    # Mailbox / exchange capacities (see ops/mailbox.py).  -1 -> auto.
+    mailbox_cap: int = -1
+    # Emit a TensorBoard trace of the epidemic phase.
+    profile: bool = False
+    profile_dir: str = "/tmp/gossip-trace"
+    # Checkpoint every k rounds to this directory (0 = off).
+    checkpoint_every: int = 0
+    checkpoint_dir: str = ""
+    progress: bool = True  # print reference-format progress lines
+
+    # --- derived --------------------------------------------------------------
+    @property
+    def fanin_resolved(self) -> int:
+        if self.fanin >= 0:
+            return self.fanin
+        return 6 if self.compat_reference else self.fanout + 1
+
+    @property
+    def max_degree(self) -> int:
+        """Friend-list capacity.
+
+        A node's list grows by its own bootstrap (up to fanout,
+        simulator.go:95-106) and by accepted makeups (up to fanin,
+        simulator.go:66-75); eviction keeps it at fanin once saturated.
+        """
+        return max(self.fanout, self.fanin_resolved)
+
+    @property
+    def delay_span(self) -> int:
+        return self.delayhigh - self.delaylow
+
+    @property
+    def er_p_resolved(self) -> float:
+        return self.er_p if self.er_p > 0 else self.fanout / max(self.n, 1)
+
+    @property
+    def effective_time_mode(self) -> str:
+        """Push-pull anti-entropy is a synchronous per-round protocol; it always
+        runs (and is budgeted) in rounds mode regardless of `time_mode`."""
+        return "rounds" if self.protocol == "pushpull" else self.time_mode
+
+    @property
+    def mailbox_cap_resolved(self) -> int:
+        if self.mailbox_cap > 0:
+            return self.mailbox_cap
+        # Balls-in-bins: with <=N uniform messages into N bins the max load is
+        # ~ln N/ln ln N w.h.p.; 16 is comfortably beyond it for any feasible N.
+        return 16
+
+    def validate(self) -> "Config":
+        if self.n < 2:
+            raise ValueError(f"n must be >= 2, got {self.n}")
+        if self.graph == "overlay" and self.n < 3:
+            # Breakup replacement excludes two ids (self + leaver,
+            # simulator.go:87-89); with n=2 the reference's retry loop would
+            # spin forever -- reject the config instead.
+            raise ValueError("overlay graph requires n >= 3")
+        if self.fanout < 1:
+            raise ValueError(f"fanout must be >= 1, got {self.fanout}")
+        if self.fanin != -1 and self.fanin < 1:
+            raise ValueError(f"fanin must be >= 1 (or -1=auto), got {self.fanin}")
+        if self.delayhigh <= self.delaylow:
+            # The reference panics inside rand.Intn here (simulator.go:167).
+            raise ValueError(
+                f"delayhigh ({self.delayhigh}) must be > delaylow ({self.delaylow})"
+            )
+        if self.delaylow < 0:
+            raise ValueError(f"delaylow must be >= 0, got {self.delaylow}")
+        for name in ("droprate", "crashrate", "removal_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0,1], got {v}")
+        if self.backend not in BACKENDS:
+            raise ValueError(f"backend must be one of {BACKENDS}, got {self.backend!r}")
+        if self.protocol not in PROTOCOLS:
+            raise ValueError(
+                f"protocol must be one of {PROTOCOLS}, got {self.protocol!r}"
+            )
+        if self.graph not in GRAPHS:
+            raise ValueError(f"graph must be one of {GRAPHS}, got {self.graph!r}")
+        if self.time_mode not in TIME_MODES:
+            raise ValueError(
+                f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"
+            )
+        if not 0.0 < self.coverage_target <= 1.0:
+            raise ValueError(
+                f"coverage_target must be in (0,1], got {self.coverage_target}"
+            )
+        if self.max_rounds < 1:
+            raise ValueError(f"max_rounds must be >= 1, got {self.max_rounds}")
+        if self.fanout >= self.n:
+            raise ValueError(f"fanout ({self.fanout}) must be < n ({self.n})")
+        return self
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw).validate()
+
+    # --- reference-format parameter dump (simulator.go:197-204) ---------------
+    def parameter_dump(self) -> str:
+        """Reference prints flags alphabetically via flag.VisitAll with an `ms`
+        suffix on the delay flags (simulator.go:197-204)."""
+        ref = {
+            "crashrate": self.crashrate,
+            "delayhigh": f"{self.delayhigh}ms",
+            "delaylow": f"{self.delaylow}ms",
+            "droprate": self.droprate,
+            "fanin": self.fanin_resolved,
+            "fanout": self.fanout,
+            "n": self.n,
+        }
+        lines = ["=== Parameters ==="]
+        lines += [f"{k}={v}" for k, v in sorted(ref.items())]
+        return "\n".join(lines)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gossip-sim-tpu",
+        description="TPU-native gossip/epidemic simulator "
+        "(capability parity with go-distributed/gossip_simulator).",
+    )
+    d = Config()
+    # Reference flags (single-dash accepted for drop-in parity with Go's flag).
+    p.add_argument("-n", "--n", type=int, default=d.n, help="total number of nodes")
+    p.add_argument("-fanout", "--fanout", type=int, default=d.fanout, help="fanout")
+    p.add_argument(
+        "-fanin", "--fanin", type=int, default=-1,
+        help="fanin (default: fanout+1; reference defaults to the constant 6)",
+    )
+    p.add_argument("-delaylow", "--delaylow", type=int, default=d.delaylow,
+                   help="delay low (ms)")
+    p.add_argument("-delayhigh", "--delayhigh", type=int, default=d.delayhigh,
+                   help="delay high (ms)")
+    p.add_argument("-droprate", "--droprate", type=float, default=d.droprate,
+                   help="message drop rate")
+    p.add_argument("-crashrate", "--crashrate", type=float, default=d.crashrate,
+                   help="machine crash rate")
+    # Framework extensions.
+    p.add_argument("-backend", "--backend", choices=BACKENDS, default=d.backend)
+    p.add_argument("-protocol", "--protocol", choices=PROTOCOLS, default=d.protocol)
+    p.add_argument("-graph", "--graph", choices=GRAPHS, default=d.graph)
+    p.add_argument("-seed", "--seed", type=int, default=d.seed)
+    p.add_argument("-max-rounds", "--max-rounds", dest="max_rounds", type=int,
+                   default=d.max_rounds)
+    p.add_argument("-coverage-target", "--coverage-target", dest="coverage_target",
+                   type=float, default=d.coverage_target)
+    p.add_argument("-time-mode", "--time-mode", dest="time_mode",
+                   choices=TIME_MODES, default=d.time_mode)
+    p.add_argument("-removal-rate", "--removal-rate", dest="removal_rate",
+                   type=float, default=d.removal_rate)
+    p.add_argument("-er-p", "--er-p", dest="er_p", type=float, default=d.er_p)
+    p.add_argument("-compat-reference", "--compat-reference",
+                   dest="compat_reference", action="store_true")
+    p.add_argument("-profile", "--profile", action="store_true")
+    p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
+                   default=d.profile_dir)
+    p.add_argument("-checkpoint-every", "--checkpoint-every",
+                   dest="checkpoint_every", type=int, default=0)
+    p.add_argument("-checkpoint-dir", "--checkpoint-dir", dest="checkpoint_dir",
+                   default="")
+    p.add_argument("-quiet", "--quiet", action="store_true",
+                   help="suppress per-window progress lines")
+    return p
+
+
+def parse_args(argv: Optional[list[str]] = None) -> Config:
+    ns = _build_parser().parse_args(argv)
+    kw = vars(ns)
+    kw["progress"] = not kw.pop("quiet")
+    return Config(**kw).validate()
+
+
+def expected_rounds(cfg: Config) -> int:
+    """Analytic upper-ish bound on rounds to 99% for SI push (SURVEY §6):
+    log_{1+f(1-d)} N + slack.  Used for buffer sizing and test tolerances."""
+    growth = 1.0 + cfg.fanout * (1.0 - cfg.droprate)
+    if growth <= 1.0:
+        return cfg.max_rounds
+    return int(math.log(max(cfg.n, 2)) / math.log(growth)) + 12

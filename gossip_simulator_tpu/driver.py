@@ -1,0 +1,119 @@
+"""Two-phase driver: overlay construction, then epidemic broadcast.
+
+Mirrors the reference `main()` (simulator.go:207-255) with the same observable
+output surface (§0 of SURVEY.md), plus a max-rounds liveness bound the
+reference lacks (it spins forever if 99% is unreachable, simulator.go:243-251)
+and optional profiling/checkpointing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+from gossip_simulator_tpu.backends import make_stepper
+from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter, Stats
+
+
+@dataclasses.dataclass
+class RunResult:
+    stats: Stats
+    stabilize_ms: float  # simulated ms for overlay construction
+    coverage_ms: float  # simulated ms to reach the coverage target
+    converged: bool
+    overlay_windows: int
+    gossip_windows: int
+
+
+def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
+                   stepper: Optional[Stepper] = None) -> RunResult:
+    cfg = cfg.validate()
+    printer = printer or ProgressPrinter(enabled=cfg.progress)
+    stepper = stepper or make_stepper(cfg)
+
+    printer.params(cfg.parameter_dump())
+    stepper.init()
+
+    # --- Phase 1: overlay (simulator.go:219-235) ------------------------------
+    printer.section("Constructing Overlay")
+    overlay_windows = 0
+    max_overlay_windows = max(cfg.max_rounds, 1000)
+    while True:
+        makeups, breakups, quiesced = stepper.overlay_window()
+        overlay_windows += 1
+        if quiesced:
+            break
+        # Reference prints the window line only when *not* quiescing
+        # (simulator.go:227-230).
+        printer.overlay_window(breakups, makeups, stepper.sim_time_ms())
+        if overlay_windows >= max_overlay_windows:
+            raise RuntimeError(
+                f"overlay did not stabilize within {max_overlay_windows} windows")
+    stabilize_ms = stepper.sim_time_ms()
+    printer.stabilized(stabilize_ms)
+
+    # --- Phase 2: broadcast (simulator.go:237-253) ----------------------------
+    printer.section("Broadcast one message")
+    stepper.seed()
+    target = cfg.coverage_target
+    window_rounds = WINDOW_MS if cfg.effective_time_mode == "ticks" else 1
+    max_windows = max(1, cfg.max_rounds // window_rounds)
+    gossip_windows = 0
+    converged = False
+    ckpt = _Checkpointer(cfg, stepper)
+    with _maybe_profile(cfg):
+        while True:
+            stats = stepper.gossip_window()
+            gossip_windows += 1
+            pct = stats.coverage * 100.0
+            printer.coverage_window(round(pct, 4), stepper.sim_time_ms())
+            ckpt.maybe_save(gossip_windows, stats)
+            if stats.coverage >= target:
+                converged = True
+                break
+            if gossip_windows >= max_windows:
+                break
+            if getattr(stepper, "exhausted", False):
+                break  # no messages in flight and nothing can change
+    coverage_ms = stepper.sim_time_ms()
+    stats = stepper.stats()
+    printer.done(coverage_ms, stats, target_pct=target * 100.0, converged=converged)
+    return RunResult(
+        stats=stats,
+        stabilize_ms=stabilize_ms,
+        coverage_ms=coverage_ms,
+        converged=converged,
+        overlay_windows=overlay_windows,
+        gossip_windows=gossip_windows,
+    )
+
+
+class _Checkpointer:
+    def __init__(self, cfg: Config, stepper: Stepper):
+        self.cfg, self.stepper = cfg, stepper
+
+    def maybe_save(self, window: int, stats: Stats) -> None:
+        cfg = self.cfg
+        if not cfg.checkpoint_every or not cfg.checkpoint_dir:
+            return
+        if window % cfg.checkpoint_every:
+            return
+        from gossip_simulator_tpu.utils import checkpoint
+
+        tree = self.stepper.state_pytree()
+        if tree is not None:
+            checkpoint.save(cfg.checkpoint_dir, window, tree, stats)
+
+
+@contextlib.contextmanager
+def _maybe_profile(cfg: Config):
+    if not cfg.profile:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(cfg.profile_dir):
+        yield

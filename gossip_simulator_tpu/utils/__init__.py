@@ -1,0 +1,7 @@
+from gossip_simulator_tpu.utils.metrics import Stats, ProgressPrinter
+
+# NOTE: utils.rng imports jax and is deliberately NOT re-exported here, so the
+# native oracle stays importable without jax (lazy-import policy of
+# backends/__init__.py).
+
+__all__ = ["Stats", "ProgressPrinter"]

@@ -1,0 +1,43 @@
+"""Round-indexed state snapshots (SURVEY §5.4: the reference has none; at
+100M-node scale a resumable snapshot is nearly free and worth having).
+
+Format: one ``.npz`` per snapshot holding the state pytree's leaves plus a
+JSON sidecar of counters.  Orbax would also work, but npz keeps the native
+(non-JAX) backends checkpointable with zero extra deps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from gossip_simulator_tpu.utils.metrics import Stats
+
+
+def save(ckpt_dir: str, window: int, tree: dict[str, Any], stats: Stats) -> str:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"state_{window:08d}.npz")
+    arrays = {k: np.asarray(v) for k, v in tree.items()}
+    np.savez_compressed(path, **arrays)
+    with open(path + ".json", "w") as f:
+        json.dump({"window": window, **stats.to_dict()}, f)
+    return path
+
+
+def latest(ckpt_dir: str) -> Optional[str]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    snaps = sorted(p for p in os.listdir(ckpt_dir) if p.endswith(".npz"))
+    return os.path.join(ckpt_dir, snaps[-1]) if snaps else None
+
+
+def load(path: str) -> tuple[dict[str, np.ndarray], dict]:
+    arrays = dict(np.load(path))
+    meta = {}
+    if os.path.exists(path + ".json"):
+        with open(path + ".json") as f:
+            meta = json.load(f)
+    return arrays, meta

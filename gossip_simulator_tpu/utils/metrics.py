@@ -1,0 +1,120 @@
+"""Counters and reference-format progress output.
+
+The reference keeps six global int32 atomics (simulator.go:26-31) polled every
+10 ms by the driver, printing:
+
+    break <B> makeup <M> elasped <t>          (simulator.go:230, typo intact)
+    --- Took <t> to stabilize ---             (simulator.go:235)
+    <p>% covered, took <t>                    (simulator.go:247)
+    --- Took <t> to get 99% ---               (simulator.go:252)
+    Total message <M> Total Crashed <C>       (simulator.go:253)
+
+Here the counters are device-resident scalars updated inside the jitted step
+and fetched once per progress window; totals are validated against int32
+overflow (the reference would silently wrap at ~430M-node scale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from typing import Callable, Optional
+
+
+@dataclasses.dataclass
+class Stats:
+    """Host-side snapshot of the simulation counters."""
+
+    n: int = 0
+    round: int = 0
+    total_received: int = 0  # nodes infected (reference: TotalReceived)
+    total_message: int = 0  # messages delivered to live nodes (TotalMessage)
+    total_crashed: int = 0  # nodes crashed by reception (TotalCrashed)
+    makeups: int = 0  # membership events this run (MakeUps)
+    breakups: int = 0  # (BreakUps)
+    mailbox_dropped: int = 0  # framework-only: capacity-overflow drops
+    exchange_overflow: int = 0  # framework-only: all_to_all bucket overflow
+
+    @property
+    def coverage(self) -> float:
+        return self.total_received / self.n if self.n else 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["coverage"] = self.coverage
+        return d
+
+
+def fmt_sim_ms(ms: float) -> str:
+    """Render simulated milliseconds the way Go renders time.Duration
+    (e.g. ``231ms``, ``1.24s``)."""
+    if ms < 1000:
+        return f"{ms:g}ms"
+    return f"{ms / 1000.0:g}s"
+
+
+class ProgressPrinter:
+    """Reference-format progress lines plus optional JSONL structured log."""
+
+    def __init__(self, enabled: bool = True, jsonl_path: Optional[str] = None,
+                 out=None):
+        # enabled=False ("quiet") suppresses only the per-window progress
+        # lines; parameters, phase summaries, and final totals always print.
+        self.enabled = enabled
+        self.out = out or sys.stdout
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._t0 = time.perf_counter()
+
+    def _emit(self, line: str, progress_only: bool = False, **record):
+        if self.enabled or not progress_only:
+            print(line, file=self.out, flush=True)
+        if self._jsonl:
+            record["wall_s"] = time.perf_counter() - self._t0
+            self._jsonl.write(json.dumps(record) + "\n")
+            self._jsonl.flush()
+
+    def params(self, dump: str):
+        self._emit(dump, event="params")
+
+    def overlay_window(self, breakups: int, makeups: int, sim_ms: float):
+        # simulator.go:230 -- including the `elasped` typo for parity.
+        self._emit(
+            f"break {breakups} makeup {makeups} elasped {fmt_sim_ms(sim_ms)}",
+            progress_only=True,
+            event="overlay", breakups=breakups, makeups=makeups, sim_ms=sim_ms,
+        )
+
+    def stabilized(self, sim_ms: float):
+        self._emit(f"--- Took {fmt_sim_ms(sim_ms)} to stabilize ---\n",
+                   event="stabilized", sim_ms=sim_ms)
+
+    def coverage_window(self, pct: float, sim_ms: float):
+        # simulator.go:247 prints float32 percent*100 with %v.
+        self._emit(f"{pct:g}% covered, took {fmt_sim_ms(sim_ms)}",
+                   progress_only=True, event="coverage", pct=pct, sim_ms=sim_ms)
+
+    def done(self, sim_ms: float, stats: Stats, target_pct: float = 99.0,
+             converged: bool = True):
+        if converged:
+            self._emit(f"--- Took {fmt_sim_ms(sim_ms)} to get {target_pct:g}% ---\n",
+                       event="done", sim_ms=sim_ms, **stats.to_dict())
+        else:
+            # Reference has no liveness bound and would spin forever
+            # (simulator.go:243-251); we report non-convergence explicitly.
+            self._emit(
+                f"--- Did NOT reach {target_pct:g}% after {fmt_sim_ms(sim_ms)} "
+                f"(max rounds) ---\n",
+                event="nonconvergence", sim_ms=sim_ms, **stats.to_dict())
+        self._emit(
+            f"Total message {stats.total_message} Total Crashed {stats.total_crashed}",
+            event="totals", **stats.to_dict())
+
+    def section(self, title: str):
+        self._emit(f"\n=== {title} ===", event="section", title=title)
+
+    def close(self):
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None

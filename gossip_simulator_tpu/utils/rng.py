@@ -1,0 +1,74 @@
+"""Stateless, counter-based randomness for the simulator.
+
+The reference uses Go's global, *unseeded* ``math/rand`` (simulator.go never
+calls ``rand.Seed``), so runs are deterministic-per-Go-version by accident.
+Here every random draw is derived from ``(seed, round, op)`` via
+``jax.random.fold_in``, making runs reproducible by construction and letting
+each jitted step be a pure function of ``(state, tick)``.
+
+Op tags keep draws for different purposes independent within a tick.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Op tags (arbitrary distinct constants).
+OP_CRASH = 1
+OP_DROP = 2
+OP_DELAY = 3
+OP_BOOTSTRAP = 4
+OP_EVICT = 5
+OP_REPLACE = 6
+OP_SEED_NODE = 7
+OP_GRAPH = 8
+OP_PULL = 9
+OP_REMOVE = 10
+
+
+def base_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def tick_key(key: jax.Array, tick, op: int) -> jax.Array:
+    """Key for operation `op` at round/tick `tick`."""
+    return jax.random.fold_in(jax.random.fold_in(key, tick), op)
+
+
+def bernoulli(key: jax.Array, p, shape, compat_reference: bool = False) -> jax.Array:
+    """Bernoulli(p) mask.
+
+    With ``compat_reference`` reproduces the reference's 1%-resolution
+    truncation ``rand.Intn(100) < int(p*100)`` (simulator.go:172,180) under
+    which p=0.001 is exactly 0.
+    """
+    if compat_reference:
+        p = int(float(p) * 100) / 100.0
+    if p <= 0.0:
+        return jnp.zeros(shape, dtype=bool)
+    if p >= 1.0:
+        return jnp.ones(shape, dtype=bool)
+    return jax.random.bernoulli(key, p, shape)
+
+
+def uniform_delay(key: jax.Array, low: int, high: int, shape) -> jax.Array:
+    """Integer ticks uniform in [low, high), matching RandomNetworkDelay
+    (simulator.go:166-168); clamped to >= 1 so a message never lands in the
+    current tick's already-drained ring slot."""
+    d = jax.random.randint(key, shape, low, high, dtype=jnp.int32)
+    return jnp.maximum(d, 1)
+
+
+def randint_excluding(key: jax.Array, n: int, shape, *exclude) -> jax.Array:
+    """Uniform draw from [0, n) then deterministically stepped off any of the
+    excluded values (per-element arrays).  Mirrors the reference's non-uniform
+    collision patches (the ``(id+1)%N`` fix at simulator.go:98-100 and the
+    retry loop at simulator.go:87-89) with a bounded, jit-friendly remap:
+    after k passes over k excluded values the result avoids all of them."""
+    r = jax.random.randint(key, shape, 0, n, dtype=jnp.int32)
+    k = len(exclude)
+    for _ in range(k + 1):
+        for e in exclude:
+            r = jnp.where(r == e, (r + 1) % n, r)
+    return r
