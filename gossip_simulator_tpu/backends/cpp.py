@@ -1,0 +1,123 @@
+"""ctypes wrapper for the C++ event-driven backend (native_cpp/gossip_sim.cpp).
+
+Same Stepper surface and semantics as backends/native.py, at native speed --
+the CPU baseline standing in for the reference's Go loop in bench.py.
+The shared library is built lazily with g++ on first use and cached next to
+the source (pybind11 is not available in this image; the C API + ctypes
+keeps the binding dependency-free).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.utils.metrics import Stats
+
+_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native_cpp")
+_SRC = os.path.join(_DIR, "gossip_sim.cpp")
+_LIB = os.path.join(_DIR, "libgossip_sim.so")
+
+_PROTO = {"si": 0, "pushpull": 1, "sir": 2}
+_GRAPH = {"overlay": 0, "kout": 1, "erdos": 2, "ring": 3}
+
+
+def _build_lib() -> str:
+    if (not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
+             "-o", _LIB + ".tmp"],
+            check=True, capture_output=True)
+        os.replace(_LIB + ".tmp", _LIB)
+    return _LIB
+
+
+_lib = None
+
+
+def load_lib():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_build_lib())
+        lib.sim_create.restype = ctypes.c_void_p
+        lib.sim_create.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32]
+        lib.sim_destroy.argtypes = [ctypes.c_void_p]
+        lib.sim_overlay_window.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.sim_seed.argtypes = [ctypes.c_void_p]
+        lib.sim_gossip_window.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.sim_stats.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64)]
+        lib.sim_now.restype = ctypes.c_double
+        lib.sim_now.argtypes = [ctypes.c_void_p]
+        lib.sim_phase_start.restype = ctypes.c_double
+        lib.sim_phase_start.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class CppStepper(Stepper):
+    name = "cpp"
+
+    def init(self) -> None:
+        cfg = self.cfg
+        self._lib = load_lib()
+        er_lambda = cfg.er_p_resolved * cfg.n
+        self._h = self._lib.sim_create(
+            cfg.n, cfg.fanout, cfg.fanin_resolved, cfg.delaylow, cfg.delayhigh,
+            cfg.droprate, cfg.crashrate, cfg.removal_rate, er_lambda,
+            _PROTO[cfg.protocol], _GRAPH[cfg.graph],
+            1 if cfg.effective_time_mode == "rounds" else 0,
+            1 if cfg.compat_reference else 0, cfg.seed)
+        self._win = (WINDOW_MS if cfg.effective_time_mode == "ticks" else 1)
+        self.exhausted = False
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.sim_destroy(h)
+            self._h = None
+
+    def overlay_window(self) -> tuple[int, int, bool]:
+        mk = ctypes.c_int64()
+        bk = ctypes.c_int64()
+        q = ctypes.c_int32()
+        self._lib.sim_overlay_window(self._h, float(self._win),
+                                     ctypes.byref(mk), ctypes.byref(bk),
+                                     ctypes.byref(q))
+        return mk.value, bk.value, bool(q.value)
+
+    def seed(self) -> None:
+        self._lib.sim_seed(self._h)
+
+    def gossip_window(self) -> Stats:
+        self._lib.sim_gossip_window(self._h, float(self._win))
+        st = self.stats()
+        self.exhausted = self._exhausted
+        return st
+
+    def stats(self) -> Stats:
+        buf = (ctypes.c_int64 * 6)()
+        self._lib.sim_stats(self._h, buf)
+        self._exhausted = bool(buf[5]) and self.cfg.protocol != "pushpull"
+        return Stats(
+            n=self.cfg.n,
+            round=int(self.sim_time_ms()),
+            total_received=int(buf[0]), total_message=int(buf[1]),
+            total_crashed=int(buf[2]), makeups=int(buf[3]),
+            breakups=int(buf[4]),
+        )
+
+    def sim_time_ms(self) -> float:
+        return (self._lib.sim_now(self._h)
+                - self._lib.sim_phase_start(self._h))

@@ -1,0 +1,331 @@
+// Event-driven gossip simulator, C API for ctypes.
+//
+// The fast native tier of the framework's oracle/baseline path: the same
+// discrete-event semantics as backends/native.py (itself a reimplementation
+// of /root/reference/simulator.go's behavioral contract -- makeup/breakup
+// membership at simulator.go:66-106, SI receive path at simulator.go:107-123,
+// delayed broadcast at simulator.go:140-168) in C++ with a binary heap, so
+// the CPU baseline for bench.py runs at native speed like the reference's Go
+// loop (the Go toolchain is absent in this image).
+//
+// Build: g++ -O3 -march=native -shared -fPIC gossip_sim.cpp -o libgossip_sim.so
+// (done lazily by backends/cpp.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+namespace {
+
+enum Kind : int32_t { BOOT = 0, MAKEUP = 1, BREAKUP = 2, MSG = 3, REBROADCAST = 4 };
+enum Protocol : int32_t { SI = 0, PUSHPULL = 1, SIR = 2 };
+enum Graph : int32_t { OVERLAY = 0, KOUT = 1, ERDOS = 2, RING = 3 };
+
+struct Event {
+  double t;
+  uint64_t seq;
+  int32_t kind;
+  int32_t dst;
+  int32_t src;
+};
+struct EventCmp {
+  bool operator()(const Event& a, const Event& b) const {
+    if (a.t != b.t) return a.t > b.t;  // min-heap
+    return a.seq > b.seq;
+  }
+};
+
+struct Params {
+  int64_t n;
+  int32_t fanout, fanin;
+  int32_t delaylow, delayhigh;
+  double droprate, crashrate, removal_rate;
+  double er_lambda;
+  int32_t protocol, graph, rounds_mode, compat, seed;
+};
+
+struct Sim {
+  Params p;
+  std::mt19937_64 rng;
+  std::vector<std::vector<int32_t>> friends;
+  std::vector<uint8_t> received, crashed, removed;
+  std::priority_queue<Event, std::vector<Event>, EventCmp> heap;
+  uint64_t seq = 0;
+  int64_t pending_membership = 0;
+  double now = 0.0, phase_start = 0.0;
+  int64_t total_message = 0, total_received = 0, total_crashed = 0;
+  int64_t makeups = 0, breakups = 0;
+  int64_t win_makeups = 0, win_breakups = 0;
+  bool overlay_done = false;
+  bool exhausted = false;
+
+  double urand() { return std::uniform_real_distribution<double>(0.0, 1.0)(rng); }
+  int64_t irand(int64_t hi) {  // [0, hi)
+    return std::uniform_int_distribution<int64_t>(0, hi - 1)(rng);
+  }
+  double p_eff(double p) const {
+    // simulator.go:172,180: rand.Intn(100) < int(p*100) truncation.
+    return p_compat ? std::trunc(p * 100.0) / 100.0 : p;
+  }
+  bool p_compat = false;
+  bool bern(double p) {
+    double q = p_eff(p);
+    return q > 0.0 && urand() < q;
+  }
+  double delay() {
+    if (p.rounds_mode) return 1.0;
+    int64_t d = p.delaylow + irand(p.delayhigh - p.delaylow);
+    return d < 1 ? 1.0 : double(d);
+  }
+
+  void push(double t, int32_t kind, int32_t dst, int32_t src) {
+    if (kind == BOOT || kind == MAKEUP || kind == BREAKUP) pending_membership++;
+    heap.push({t, ++seq, kind, dst, src});
+  }
+
+  void init() {
+    rng.seed(uint64_t(p.seed));
+    p_compat = p.compat != 0;
+    friends.assign(p.n, {});
+    received.assign(p.n, 0);
+    crashed.assign(p.n, 0);
+    removed.assign(p.n, 0);
+    if (p.graph == OVERLAY) {
+      for (int64_t i = 0; i < p.n; ++i) push(0.0, BOOT, int32_t(i), -1);
+      overlay_done = false;
+    } else {
+      gen_static();
+      overlay_done = true;
+    }
+  }
+
+  void gen_static() {
+    if (p.graph == KOUT) {
+      for (int64_t i = 0; i < p.n; ++i) {
+        friends[i].reserve(p.fanout);
+        for (int32_t j = 0; j < p.fanout; ++j) {
+          int64_t x = irand(p.n);
+          if (x == i) x = (x + 1) % p.n;  // simulator.go:98-100 patch
+          friends[i].push_back(int32_t(x));
+        }
+      }
+    } else if (p.graph == ERDOS) {
+      std::poisson_distribution<int32_t> pois(p.er_lambda);
+      for (int64_t i = 0; i < p.n; ++i) {
+        int32_t d = pois(rng);
+        friends[i].reserve(d);
+        for (int32_t j = 0; j < d; ++j) {
+          int64_t x = irand(p.n);
+          if (x == i) x = (x + 1) % p.n;
+          friends[i].push_back(int32_t(x));
+        }
+      }
+    } else {  // RING
+      for (int64_t i = 0; i < p.n; ++i)
+        for (int32_t j = 1; j <= p.fanout; ++j)
+          friends[i].push_back(int32_t((i + j) % p.n));
+    }
+  }
+
+  void broadcast(double t, int32_t node) {
+    // One shared delay per broadcast; per-link drop (simulator.go:140-149).
+    double d = delay();
+    for (int32_t f : friends[node])
+      if (!bern(p.droprate)) push(t + d, MSG, f, node);
+    if (p.protocol == SIR) {
+      if (bern(p.removal_rate)) removed[node] = 1;
+      else push(t + d, REBROADCAST, node, node);
+    }
+  }
+
+  void receive(double t, int32_t dst) {
+    if (crashed[dst]) return;  // black-hole, uncounted (simulator.go:108-110)
+    total_message++;
+    if (bern(p.crashrate)) { crashed[dst] = 1; total_crashed++; return; }
+    if (received[dst]) return;  // duplicate (simulator.go:117-119)
+    received[dst] = 1;
+    total_received++;
+    broadcast(t, dst);
+  }
+
+  void handle(const Event& e) {
+    if (e.kind == BOOT || e.kind == MAKEUP || e.kind == BREAKUP)
+      pending_membership--;
+    auto& f = friends[e.dst];
+    switch (e.kind) {
+      case BOOT: {  // simulator.go:95-106
+        if (int32_t(f.size()) < p.fanout) {
+          int64_t x = irand(p.n);
+          if (x == e.dst) x = (x + 1) % p.n;
+          f.push_back(int32_t(x));
+          push(e.t + delay(), MAKEUP, int32_t(x), e.dst);
+          if (int32_t(f.size()) < p.fanout) push(e.t, BOOT, e.dst, -1);
+        }
+        break;
+      }
+      case MAKEUP: {  // simulator.go:66-75
+        makeups++; win_makeups++;
+        if (int32_t(f.size()) < p.fanin) {
+          f.push_back(e.src);
+        } else {
+          int64_t vp = irand(f.size());
+          push(e.t + delay(), BREAKUP, f[vp], e.dst);
+          f[vp] = e.src;
+        }
+        break;
+      }
+      case BREAKUP: {  // simulator.go:76-94
+        breakups++; win_breakups++;
+        for (size_t i = 0; i < f.size(); ++i) {
+          if (f[i] == e.src) {
+            if (int32_t(f.size()) > p.fanout) {
+              f.erase(f.begin() + i);  // order-preserving (simulator.go:127-138)
+            } else {
+              int64_t x;
+              do { x = irand(p.n); } while (x == e.src || x == e.dst);
+              f[i] = int32_t(x);
+              push(e.t + delay(), MAKEUP, int32_t(x), e.dst);
+            }
+            break;
+          }
+        }
+        break;
+      }
+      case MSG:
+        receive(e.t, e.dst);
+        break;
+      case REBROADCAST:
+        if (!crashed[e.dst] && !removed[e.dst]) broadcast(e.t, e.dst);
+        break;
+    }
+  }
+
+  void drain(double end) {
+    while (!heap.empty() && heap.top().t < end) {
+      Event e = heap.top();
+      heap.pop();
+      handle(e);
+    }
+  }
+
+  void overlay_window(double win, int64_t* mk, int64_t* bk, int32_t* quiesced) {
+    if (overlay_done) { *mk = *bk = 0; *quiesced = 1; return; }
+    win_makeups = win_breakups = 0;
+    drain(now + win);
+    now += win;
+    *mk = win_makeups;
+    *bk = win_breakups;
+    bool q = win_makeups == 0 && win_breakups == 0 && pending_membership == 0;
+    if (q) overlay_done = true;
+    *quiesced = q ? 1 : 0;
+  }
+
+  void seed() {
+    phase_start = now;
+    int32_t sender = int32_t(irand(p.n));
+    if (p.protocol == PUSHPULL) {
+      received[sender] = 1;
+      total_received++;
+      return;
+    }
+    if (!p_compat) { received[sender] = 1; total_received++; }
+    broadcast(now, sender);
+  }
+
+  void pushpull_round() {
+    // Mirrors backends/native.py::_pushpull_round (round-synchronous).
+    std::vector<int32_t> newly;
+    std::vector<uint8_t> rcv0 = received, crs0 = crashed;
+    // push
+    for (int64_t i = 0; i < p.n; ++i) {
+      if (!rcv0[i] || crs0[i]) continue;
+      for (int32_t j = 0; j < p.fanout; ++j) {
+        int64_t tgt = irand(p.n);
+        if (bern(p.droprate)) continue;
+        if (crashed[tgt]) continue;
+        total_message++;
+        if (bern(p.crashrate)) {
+          if (!crashed[tgt]) { crashed[tgt] = 1; total_crashed++; }
+          continue;
+        }
+        if (!received[tgt] && !crashed[tgt]) { received[tgt] = 1; total_received++; }
+      }
+    }
+    // pull (susceptible by the round-start snapshot)
+    for (int64_t i = 0; i < p.n; ++i) {
+      if (rcv0[i] || crashed[i]) continue;
+      bool hit = false;
+      for (int32_t j = 0; j < p.fanout; ++j) {
+        int64_t tgt = irand(p.n);
+        if (bern(p.droprate)) continue;
+        if (crs0[tgt]) continue;
+        total_message++;
+        if (rcv0[tgt]) hit = true;
+      }
+      if (hit && !received[i]) { received[i] = 1; total_received++; }
+    }
+  }
+
+  void gossip_window(double win) {
+    if (p.protocol == PUSHPULL) {
+      pushpull_round();
+      now += 1.0;
+      return;
+    }
+    drain(now + win);
+    now += win;
+    exhausted = heap.empty();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sim_create(int64_t n, int32_t fanout, int32_t fanin, int32_t delaylow,
+                 int32_t delayhigh, double droprate, double crashrate,
+                 double removal_rate, double er_lambda, int32_t protocol,
+                 int32_t graph, int32_t rounds_mode, int32_t compat,
+                 int32_t seed) {
+  Sim* s = new Sim();
+  s->p = {n, fanout, fanin, delaylow, delayhigh, droprate, crashrate,
+          removal_rate, er_lambda, protocol, graph, rounds_mode, compat, seed};
+  s->init();
+  return s;
+}
+
+void sim_destroy(void* h) { delete static_cast<Sim*>(h); }
+
+void sim_overlay_window(void* h, double win, int64_t* mk, int64_t* bk,
+                        int32_t* quiesced) {
+  static_cast<Sim*>(h)->overlay_window(win, mk, bk, quiesced);
+}
+
+void sim_seed(void* h) { static_cast<Sim*>(h)->seed(); }
+
+void sim_gossip_window(void* h, double win) {
+  static_cast<Sim*>(h)->gossip_window(win);
+}
+
+void sim_stats(void* h, int64_t* out) {
+  Sim* s = static_cast<Sim*>(h);
+  out[0] = s->total_received;
+  out[1] = s->total_message;
+  out[2] = s->total_crashed;
+  out[3] = s->makeups;
+  out[4] = s->breakups;
+  out[5] = s->exhausted ? 1 : 0;
+}
+
+double sim_now(void* h) { return static_cast<Sim*>(h)->now; }
+double sim_phase_start(void* h) { return static_cast<Sim*>(h)->phase_start; }
+
+void sim_degrees(void* h, int32_t* out) {
+  Sim* s = static_cast<Sim*>(h);
+  for (int64_t i = 0; i < s->p.n; ++i) out[i] = int32_t(s->friends[i].size());
+}
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+"""Mesh-sharded backend: the 100M-node path (BASELINE.json config 5).
+
+Same Stepper surface as the single-chip jax backend; state lives sharded
+across the mesh from birth (graph generation happens per shard -- nothing
+is ever materialized on one device), and every window is one jitted
+shard_map call whose collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
+from gossip_simulator_tpu.models import epidemic, overlay
+from gossip_simulator_tpu.parallel import sharded_step
+from gossip_simulator_tpu.parallel.mesh import node_mesh, shard_size
+from gossip_simulator_tpu.utils import rng as _rng
+from gossip_simulator_tpu.utils.metrics import Stats
+
+
+class ShardedStepper(Stepper):
+    name = "sharded"
+
+    def __init__(self, cfg, n_devices: int | None = None):
+        super().__init__(cfg)
+        self.mesh = node_mesh(n_devices)
+        shard_size(cfg.n, self.mesh)  # validate divisibility early
+
+    def init(self) -> None:
+        cfg = self.cfg
+        self.key = _rng.base_key(cfg.seed)
+        self._mean_delay = (
+            (cfg.delaylow + cfg.delayhigh) / 2.0
+            if cfg.effective_time_mode == "ticks" else 1.0)
+        self._overlay_rounds = 0
+        self.exhausted = False
+        self._mailbox_dropped = 0
+        self._window = 1 if cfg.effective_time_mode == "rounds" else WINDOW_MS
+        self._window_fn = sharded_step.make_window_fn(cfg, self.mesh,
+                                                      self._window)
+        self._seed_fn = sharded_step.make_seed_fn(cfg, self.mesh)
+        self._run_fn = sharded_step.make_run_to_coverage_fn(cfg, self.mesh)
+        if cfg.graph == "overlay":
+            self._oround = sharded_step.make_overlay_round_fn(cfg, self.mesh)
+            self.ostate = sharded_step.make_sharded_overlay_init(
+                cfg, self.mesh)()
+            self._overlay_done = False
+            self.state = None
+        else:
+            self.state = sharded_step.make_sharded_init(cfg, self.mesh)()
+            self._overlay_done = True
+
+    # --- phase 1 ---------------------------------------------------------------
+    def overlay_window(self) -> tuple[int, int, bool]:
+        if self._overlay_done:
+            return 0, 0, True
+        self.ostate = self._oround(self.ostate, self.key)
+        self._overlay_rounds += 1
+        mk, bk, q = jax.device_get(
+            (self.ostate.win_makeups, self.ostate.win_breakups,
+             overlay.quiesced(self.ostate)))
+        if bool(q):
+            self._overlay_done = True
+            self._mailbox_dropped = int(
+                jax.device_get(self.ostate.mailbox_dropped))
+            self.state = self._epidemic_from_overlay()
+            self.ostate = None
+        return int(mk), int(bk), bool(q)
+
+    def _epidemic_from_overlay(self):
+        cfg, mesh = self.cfg, self.mesh
+        n_local = shard_size(cfg.n, mesh)
+        from jax.sharding import PartitionSpec as P
+
+        def build(friends, cnt):
+            return epidemic.init_state(cfg, friends, cnt, n_local=n_local)
+
+        fn = jax.shard_map(build, mesh=mesh,
+                           in_specs=(P("nodes", None), P("nodes")),
+                           out_specs=sharded_step.sim_state_specs(),
+                           check_vma=False)
+        return jax.jit(fn)(self.ostate.friends, self.ostate.friend_cnt)
+
+    # --- phase 2 ---------------------------------------------------------------
+    def seed(self) -> None:
+        self.state = self._seed_fn(self.state, self.key)
+
+    def gossip_window(self) -> Stats:
+        self.state = self._window_fn(self.state, self.key)
+        stats = self.stats()
+        in_flight = int(jax.device_get(
+            self.state.pending.sum() + self.state.rebroadcast.sum()))
+        self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
+        return stats
+
+    def run_to_target(self) -> Stats:
+        target = int(np.ceil(self.cfg.coverage_target * self.cfg.n))
+        self.state = self._run_fn(self.state, self.key, target)
+        jax.block_until_ready(self.state.total_received)
+        return self.stats()
+
+    def stats(self) -> Stats:
+        st = self.state
+        tm, tr, tc, xo = jax.device_get(
+            (st.total_message, st.total_received, st.total_crashed,
+             st.exchange_overflow))
+        return Stats(
+            n=self.cfg.n, round=int(jax.device_get(st.tick)),
+            total_received=int(tr), total_message=int(tm),
+            total_crashed=int(tc), mailbox_dropped=self._mailbox_dropped,
+            exchange_overflow=int(xo),
+        )
+
+    def sim_time_ms(self) -> float:
+        if self.state is None or not self._overlay_done:
+            return self._overlay_rounds * self._mean_delay
+        return float(jax.device_get(self.state.tick))
+
+    def state_pytree(self):
+        if self.state is None:
+            return None
+        return {k: np.asarray(v) for k, v in self.state._asdict().items()}

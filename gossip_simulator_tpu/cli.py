@@ -5,6 +5,7 @@ table and divergence notes)."""
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Optional
 
@@ -12,8 +13,29 @@ from gossip_simulator_tpu.config import parse_args
 from gossip_simulator_tpu.driver import run_simulation
 
 
+def _maybe_reexec_for_cpu(argv: Optional[list[str]]) -> None:
+    """When the user explicitly requests the CPU platform on a host whose
+    sitecustomize registers a TPU PJRT plugin with remote compilation (this
+    image's axon relay), re-exec once with the plugin disabled -- otherwise
+    even CPU compiles block on the remote relay."""
+    if (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+            and os.environ.get("PALLAS_AXON_POOL_IPS")
+            and os.environ.get("_GOSSIP_CLI_REEXEC") != "1"):
+        env = dict(os.environ)
+        env["_GOSSIP_CLI_REEXEC"] = "1"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        args = sys.argv[1:] if argv is None else list(argv)
+        os.execve(sys.executable,
+                  [sys.executable, "-m", "gossip_simulator_tpu", *args], env)
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     cfg = parse_args(argv)
+    if cfg.backend in ("jax", "sharded"):
+        _maybe_reexec_for_cpu(argv)
+        from gossip_simulator_tpu.utils import jaxsetup
+
+        jaxsetup.setup()
     result = run_simulation(cfg)
     return 0 if result.converged else 2
 

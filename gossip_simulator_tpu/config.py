@@ -48,7 +48,7 @@ class Config:
     crashrate: float = 0.001
 
     # --- framework extensions -------------------------------------------------
-    backend: str = "native"  # TODO(round 1): flip to "jax" once jax_backend lands
+    backend: str = "jax"
     protocol: str = "si"
     graph: str = "overlay"
     seed: int = 0

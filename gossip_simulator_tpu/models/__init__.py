@@ -1,0 +1,4 @@
+from gossip_simulator_tpu.models.state import SimState, OverlayState
+from gossip_simulator_tpu.models import graphs, overlay, epidemic
+
+__all__ = ["SimState", "OverlayState", "graphs", "overlay", "epidemic"]

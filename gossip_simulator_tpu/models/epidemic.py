@@ -1,0 +1,326 @@
+"""Epidemic-phase step kernels (the measured hot path).
+
+The reference's per-message scalar receive path (simulator.go:107-123) and
+goroutine broadcast (simulator.go:140-149) become one fused array program per
+simulated tick:
+
+    drain ring slot -> count deliveries -> crash draw -> infect (idempotent)
+    -> gather friends of new infections -> drop mask -> scatter-add future
+    arrivals into the delay ring.
+
+Time semantics ("ticks" mode): 1 tick == 1 simulated ms.  Every broadcast
+draws ONE shared delay uniform in [delaylow, delayhigh) ticks -- exactly the
+reference's RandomNetworkDelay applied once per broadcast goroutine
+(simulator.go:141-142) -- and each link send has an independent drop draw
+(simulator.go:144).  Messages sit in a ``pending[d, n]`` ring buffer of
+arrival *counts* so duplicate deliveries are counted like the reference's
+TotalMessage (simulator.go:111) while infection stays an idempotent OR.
+
+"rounds" mode is the classic synchronous-epidemic accounting: every hop takes
+exactly one round (ring depth 2).
+
+Documented divergence: when c messages reach a node in the same tick, the
+crash draw fires with p = 1-(1-p)^c and all c messages are counted; the
+reference processes the channel serially, so messages queued behind an
+earlier crash-triggering one go uncounted (simulator.go:108-116).
+Distributionally negligible for small p; exact for c=1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models.state import SimState
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+SEED_TICK = 0x7FFFFFFF  # reserved "tick" for the one-off seed draws (fold_in needs uint32)
+
+
+def ring_depth(cfg: Config) -> int:
+    """Delay ring slots: delays are clamped to [1, delayhigh) so `delayhigh`
+    slots suffice; rounds mode needs only {this, next}."""
+    return cfg.delayhigh if cfg.effective_time_mode == "ticks" else 2
+
+
+def p_eff(cfg: Config, p: float) -> float:
+    """Reference's 1%-resolution truncation under compat (simulator.go:172,180)."""
+    return int(p * 100) / 100.0 if cfg.compat_reference else p
+
+
+def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
+               n_local: int | None = None) -> SimState:
+    n = n_local if n_local is not None else cfg.n
+    d = ring_depth(cfg)
+    d_rb = d if cfg.protocol == "sir" else 1
+    z = lambda: jnp.zeros((), I32)
+    return SimState(
+        received=jnp.zeros((n,), bool),
+        crashed=jnp.zeros((n,), bool),
+        removed=jnp.zeros((n,), bool),
+        friends=friends,
+        friend_cnt=friend_cnt,
+        pending=jnp.zeros((d, n), I32),
+        rebroadcast=jnp.zeros((d_rb, n), bool),
+        tick=z(), total_message=z(), total_received=z(), total_crashed=z(),
+        exchange_overflow=z(),
+    )
+
+
+def _delay_and_slot(cfg: Config, key, tick, shape):
+    d = ring_depth(cfg)
+    if cfg.effective_time_mode == "rounds":
+        return (tick + 1) % d
+    delay = _rng.uniform_delay(key, cfg.delaylow, cfg.delayhigh, shape)
+    return (tick + delay) % d
+
+
+def tick_keys(base_key: jax.Array, tick, shard: jax.Array | int | None = None):
+    """Per-tick op keys; `shard` (axis index) decorrelates shards in the
+    sharded backend."""
+    if shard is not None:
+        base_key = jax.random.fold_in(base_key, shard)
+    return {
+        "crash": _rng.tick_key(base_key, tick, _rng.OP_CRASH),
+        "delay": _rng.tick_key(base_key, tick, _rng.OP_DELAY),
+        "drop": _rng.tick_key(base_key, tick, _rng.OP_DROP),
+        "remove": _rng.tick_key(base_key, tick, _rng.OP_REMOVE),
+    }
+
+
+def tick_core(cfg: Config, st: SimState, keys: dict):
+    """The node-local physics of one tick -- everything except delivering the
+    outgoing wave: drain ring slot, count, crash draw, infect, SIR removal /
+    re-broadcast scheduling, shared-delay draw.
+
+    Shard-agnostic: arrays may be the full node axis or one shard of it.
+    Returns ``(st_partial, senders, dslot, deltas)`` where `st_partial` has
+    everything updated except `pending` additions from the new wave, `senders`
+    marks local rows broadcasting this tick, `dslot` is their target ring slot
+    and `deltas = (d_message, d_received, d_crashed)` are LOCAL sums (callers
+    psum them across shards before adding to the replicated totals).
+    """
+    sir = cfg.protocol == "sir"
+    crash_p = p_eff(cfg, cfg.crashrate)
+    d = ring_depth(cfg)
+    n = st.received.shape[0]
+    ids = jnp.arange(n, dtype=I32)
+
+    slot = st.tick % d
+    arrivals = st.pending[slot]
+    pending = st.pending.at[slot].set(0)
+    counted = jnp.where(st.crashed, 0, arrivals)  # black-hole, uncounted
+    d_message = counted.sum(dtype=I32)
+    has = counted > 0
+
+    if crash_p > 0.0:
+        pc = 1.0 - jnp.power(1.0 - crash_p, counted.astype(jnp.float32))
+        new_crash = (jax.random.uniform(keys["crash"], (n,)) < pc) & has
+    else:
+        new_crash = jnp.zeros((n,), bool)
+    crashed = st.crashed | new_crash
+    d_crashed = new_crash.sum(dtype=I32)
+
+    newly = has & ~crashed & ~st.received
+    received = st.received | newly
+    d_received = newly.sum(dtype=I32)
+
+    dslot = _delay_and_slot(cfg, keys["delay"], st.tick, (n,))
+    dslot = jnp.broadcast_to(dslot, (n,)).astype(I32)
+
+    if sir:
+        due = st.rebroadcast[slot] & ~crashed & ~st.removed
+        rb = st.rebroadcast.at[slot].set(False)
+        senders = newly | due
+        removal = _rng.bernoulli(keys["remove"], p_eff(cfg, cfg.removal_rate),
+                                 (n,)) & senders
+        removed = st.removed | removal
+        rb = rb.at[dslot, ids].max(senders & ~removal)
+    else:
+        rb = st.rebroadcast
+        senders = newly
+        removed = st.removed
+
+    st_partial = st._replace(
+        received=received, crashed=crashed, removed=removed, pending=pending,
+        rebroadcast=rb, tick=st.tick + 1)
+    return st_partial, senders, dslot, (d_message, d_received, d_crashed)
+
+
+def edges_from_senders(cfg: Config, friends, friend_cnt, senders, dslot,
+                       drop_key):
+    """Flatten this tick's outgoing wave into (dst_global, dslot, valid) flat
+    arrays -- the message list the delivery layer (local scatter or
+    cross-shard all_to_all route) consumes.  Per-link drop draw happens here
+    (simulator.go:144); the shared per-broadcast delay came in via dslot."""
+    n, k = friends.shape
+    drop = _rng.bernoulli(drop_key, p_eff(cfg, cfg.droprate), (n, k))
+    edge = (jnp.arange(k, dtype=I32)[None, :] < friend_cnt[:, None]) \
+        & senders[:, None] & ~drop & (friends >= 0)
+    dst = jnp.where(edge, friends, -1).reshape(-1)
+    slots = jnp.broadcast_to(dslot[:, None], (n, k)).reshape(-1)
+    return dst, slots, edge.reshape(-1)
+
+
+def deposit_local(pending, dst_local, slots, valid):
+    """Scatter arrivals into the pending ring (idempotent counting add;
+    duplicates accumulate like the reference's per-message channel sends)."""
+    n = pending.shape[1]
+    dst = jnp.where(valid, dst_local, n)  # out of bounds -> mode="drop"
+    return pending.at[slots, dst].add(1, mode="drop")
+
+
+def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
+    """Single-device per-tick transition for SI / SIR push gossip."""
+
+    def tick_fn(st: SimState, base_key: jax.Array) -> SimState:
+        keys = tick_keys(base_key, st.tick)
+        stp, senders, dslot, (dm, dr, dc) = tick_core(cfg, st, keys)
+        dst, slots, valid = edges_from_senders(
+            cfg, stp.friends, stp.friend_cnt, senders, dslot, keys["drop"])
+        pending = deposit_local(stp.pending, dst, slots, valid)
+        return stp._replace(
+            pending=pending,
+            total_message=stp.total_message + dm,
+            total_received=stp.total_received + dr,
+            total_crashed=stp.total_crashed + dc)
+
+    return tick_fn
+
+
+def make_seed_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
+    """Uniform-random sender's initial broadcast (simulator.go:240-241).
+    Unless compat_reference, the seed itself is marked received (the reference
+    never marks it -- SURVEY §5.4 quirk)."""
+
+    def seed_fn(st: SimState, base_key: jax.Array) -> SimState:
+        n = st.received.shape[0]
+        ks = _rng.tick_key(base_key, SEED_TICK, _rng.OP_SEED_NODE)
+        kd = _rng.tick_key(base_key, SEED_TICK, _rng.OP_DELAY)
+        kp = _rng.tick_key(base_key, SEED_TICK, _rng.OP_DROP)
+        sender = jax.random.randint(ks, (), 0, n, dtype=I32)
+        is_sender = jnp.arange(n, dtype=I32) == sender
+        received, total_received = st.received, st.total_received
+        if cfg.protocol == "pushpull" or not cfg.compat_reference:
+            received = received | is_sender
+            total_received = total_received + 1
+        if cfg.protocol == "pushpull":
+            return st._replace(received=received, total_received=total_received)
+        dslot = _delay_and_slot(cfg, kd, st.tick, (n,))
+        dslot = jnp.broadcast_to(dslot, (n,)).astype(I32)
+        dst, slots, valid = edges_from_senders(
+            cfg, st.friends, st.friend_cnt, is_sender, dslot, kp)
+        pending = deposit_local(st.pending, dst, slots, valid)
+        rb = st.rebroadcast
+        if cfg.protocol == "sir":
+            # The seed is a sender like any other: removal draw decides
+            # whether it keeps re-broadcasting.
+            kr = _rng.tick_key(base_key, SEED_TICK, _rng.OP_REMOVE)
+            keep = ~_rng.bernoulli(kr, p_eff(cfg, cfg.removal_rate), ())
+            rb = rb.at[dslot, jnp.arange(n, dtype=I32)].max(is_sender & keep)
+        return st._replace(received=received, total_received=total_received,
+                           pending=pending, rebroadcast=rb)
+
+    return seed_fn
+
+
+def make_pushpull_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
+    """One synchronous push-pull anti-entropy round over uniform random peers
+    (BASELINE.json config 3; no referent in the reference).  Push receptions
+    are counted and can crash the receiver; pull responses from live peers are
+    counted; infection crosses any surviving contact."""
+    drop_p = p_eff(cfg, cfg.droprate)
+    crash_p = p_eff(cfg, cfg.crashrate)
+    f = cfg.fanout
+
+    def round_fn(st: SimState, base_key: jax.Array) -> SimState:
+        n = st.received.shape[0]
+        k1 = _rng.tick_key(base_key, st.tick, _rng.OP_BOOTSTRAP)
+        k2 = _rng.tick_key(base_key, st.tick, _rng.OP_PULL)
+        kd1 = _rng.tick_key(base_key, st.tick, _rng.OP_DROP)
+        kd2 = _rng.tick_key(base_key, st.tick, _rng.OP_DELAY)
+        kc = _rng.tick_key(base_key, st.tick, _rng.OP_CRASH)
+
+        live = ~st.crashed
+        inf = st.received & live
+        sus = ~st.received & live
+
+        # --- push: infected -> fanout random peers -------------------------
+        peers = jax.random.randint(k1, (n, f), 0, n, dtype=I32)
+        kept = ~_rng.bernoulli(kd1, drop_p, (n, f))
+        edge = inf[:, None] & kept
+        dst = jnp.where(edge, peers, n)
+        arriving = jnp.zeros((n,), I32).at[dst].add(1, mode="drop")
+        counted = jnp.where(live, arriving, 0)
+        total_message = st.total_message + counted.sum(dtype=I32)
+        if crash_p > 0.0:
+            pc = 1.0 - jnp.power(1.0 - crash_p, counted.astype(jnp.float32))
+            new_crash = (jax.random.uniform(kc, (n,)) < pc) & (counted > 0)
+        else:
+            new_crash = jnp.zeros((n,), bool)
+        crashed = st.crashed | new_crash
+        total_crashed = st.total_crashed + new_crash.sum(dtype=I32)
+        newly_push = (counted > 0) & ~crashed & ~st.received
+
+        # --- pull: susceptible <- fanout random peers' state ---------------
+        peers2 = jax.random.randint(k2, (n, f), 0, n, dtype=I32)
+        kept2 = ~_rng.bernoulli(kd2, drop_p, (n, f))
+        req = sus[:, None] & kept2 & ~crashed[:, None]
+        peer_live_inf = st.received[peers2] & ~st.crashed[peers2]
+        pull_hit = (req & peer_live_inf).any(axis=1)
+        total_message = total_message + (req & ~st.crashed[peers2]).sum(dtype=I32)
+
+        newly = (newly_push | pull_hit) & ~crashed & ~st.received
+        received = st.received | newly
+        total_received = st.total_received + newly.sum(dtype=I32)
+        return st._replace(received=received, crashed=crashed,
+                           tick=st.tick + 1, total_message=total_message,
+                           total_received=total_received,
+                           total_crashed=total_crashed)
+
+    return round_fn
+
+
+def make_step_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
+    if cfg.protocol == "pushpull":
+        return make_pushpull_fn(cfg)
+    return make_tick_fn(cfg)
+
+
+def make_window_fn(cfg: Config, window: int):
+    """`window` consecutive steps as one device call (one progress window)."""
+    step = make_step_fn(cfg)
+
+    @jax.jit
+    def window_fn(st: SimState, base_key: jax.Array) -> SimState:
+        return jax.lax.fori_loop(0, window, lambda _, s: step(s, base_key), st)
+
+    return window_fn
+
+
+def make_run_to_coverage_fn(cfg: Config):
+    """Device-side while_loop to the coverage target: zero host syncs in the
+    hot loop (the reference's 10 ms polling becomes one device-side predicate,
+    simulator.go:243-251).  Used by bench.py and the `-quiet` fast path."""
+    step = make_step_fn(cfg)
+    window = 1 if cfg.effective_time_mode == "rounds" else 10
+    max_steps = cfg.max_rounds
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def run_fn(st: SimState, base_key: jax.Array, target_count: int) -> SimState:
+        def cond(s: SimState):
+            return (s.total_received < target_count) & (s.tick < max_steps)
+
+        def body(s: SimState):
+            # One window per iteration keeps the predicate check off the
+            # per-tick critical path.
+            return jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), s)
+
+        return jax.lax.while_loop(cond, body, st)
+
+    return run_fn

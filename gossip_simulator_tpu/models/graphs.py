@@ -1,0 +1,94 @@
+"""Static overlay generators (device-side, O(n*k) memory, no host loops).
+
+The reference only has the dynamic makeup/breakup overlay (simulator.go:66-106);
+BASELINE.json configs 3-4 additionally name Erdos-Renyi and
+fanout-random graphs, so these are first-class here.  All generators return
+``(friends int32[n, k] -1-padded, friend_cnt int32[n])`` with *global* node
+ids, generated shard-locally for any contiguous id range [row0, row0+rows) so
+the sharded backend can build its slice without materializing the full graph.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.utils import rng as _rng
+
+
+def _self_patch(picks: jnp.ndarray, ids: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Reference's self-collision patch: (id+1)%N (simulator.go:98-100)."""
+    return jnp.where(picks == ids, (picks + 1) % n, picks)
+
+
+def _row_keys(key: jax.Array, row0: int, rows: int) -> jax.Array:
+    """One key per *global* row id, so any row slice of the graph is identical
+    no matter how the node axis is sharded (shard-consistent generation)."""
+    gids = row0 + jnp.arange(rows, dtype=jnp.int32)
+    return jax.vmap(lambda r: jax.random.fold_in(key, r))(gids)
+
+
+def kout(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None):
+    """k-out random digraph: each node picks `fanout` uniform peers
+    (duplicates allowed, like the reference's bootstrap)."""
+    n, k = cfg.n, cfg.fanout
+    rows = n if rows is None else rows
+    ids = (row0 + jnp.arange(rows, dtype=jnp.int32))[:, None]
+    keys = _row_keys(key, row0, rows)
+    picks = jax.vmap(
+        lambda rk: jax.random.randint(rk, (k,), 0, n, dtype=jnp.int32))(keys)
+    friends = _self_patch(picks, ids, n)
+    return friends, jnp.full((rows,), k, dtype=jnp.int32)
+
+
+def erdos(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None):
+    """Sparse directed Erdos-Renyi approximation: out-degree ~ Poisson(n*p)
+    (exact G(n,p) is O(n^2); Poisson out-degrees match its sparse limit).
+    Slot capacity covers the Poisson upper tail; overflow is clipped (counted
+    in degree only, probability ~1e-9 per node at lambda<=32)."""
+    n = cfg.n
+    rows = n if rows is None else rows
+    lam = cfg.er_p_resolved * n
+    cap = max(1, int(math.ceil(lam + 6.0 * math.sqrt(max(lam, 1.0)) + 4)))
+    keys = _row_keys(key, row0, rows)
+
+    def one_row(rk):
+        kd, kp = jax.random.split(rk)
+        deg = jnp.minimum(jax.random.poisson(kd, lam, ()).astype(jnp.int32), cap)
+        picks = jax.random.randint(kp, (cap,), 0, n, dtype=jnp.int32)
+        return deg, picks
+
+    deg, picks = jax.vmap(one_row)(keys)
+    ids = (row0 + jnp.arange(rows, dtype=jnp.int32))[:, None]
+    picks = _self_patch(picks, ids, n)
+    slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    friends = jnp.where(slot < deg[:, None], picks, -1)
+    return friends, deg
+
+
+def ring(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None):
+    """Ring lattice: node i -> (i+1..i+fanout) mod n.  Deterministic; handy
+    as a worst-case-diameter graph for tests."""
+    del key
+    n, k = cfg.n, cfg.fanout
+    rows = n if rows is None else rows
+    ids = (row0 + jnp.arange(rows, dtype=jnp.int32))[:, None]
+    friends = (ids + jnp.arange(1, k + 1, dtype=jnp.int32)[None, :]) % n
+    return friends.astype(jnp.int32), jnp.full((rows,), k, dtype=jnp.int32)
+
+
+GENERATORS = {"kout": kout, "erdos": erdos, "ring": ring}
+
+
+def generate(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None):
+    if cfg.graph == "overlay":
+        raise ValueError("dynamic overlay is built by models/overlay.py")
+    friends, cnt = GENERATORS[cfg.graph](cfg, key, row0, rows)
+    return friends, cnt
+
+
+def graph_key(cfg: Config) -> jax.Array:
+    return _rng.tick_key(_rng.base_key(cfg.seed), 0, _rng.OP_GRAPH)

@@ -1,0 +1,218 @@
+"""Vectorized dynamic-overlay construction (phase 1).
+
+The reference's membership protocol is inherently sequential per node: an
+event loop multiplexing makeup / breakup / need-new-friend mailboxes
+(simulator.go:62-106).  The vectorized analog runs the SAME per-message
+decision rules, but batched: one "round" delivers last round's messages into
+fixed-capacity mailboxes (ops/mailbox.py), then every node processes its
+mailbox slots *sequentially across slots, in parallel across nodes* -- a
+`fori_loop` over slot index k in which iteration k applies all nodes' k-th
+message.  Message emissions (replacement makeups, eviction breakups,
+bootstrap makeups) are buffered and delivered next round, standing in for the
+reference's delayed channel sends (simulator.go:151-164).
+
+Decision-rule parity (per message, against simulator.go):
+* makeup  (simulator.go:66-75): under fanin -> append sender; else evict a
+  uniform-random victim (sending it a breakup) and take its slot.
+* breakup (simulator.go:76-94): first-match scan; over fanout -> remove
+  (swap-with-last here -- order is immaterial because eviction is uniform);
+  else replace in place with a fresh random peer (!= self, != leaver) and
+  send it a makeup.
+* bootstrap (simulator.go:95-106): while under fanout, add one uniform
+  random friend per round (self patched as (id+1)%N, duplicates allowed)
+  and send a makeup.
+
+This preserves the stationary degree distribution (friend_cnt in
+[fanout, max(fanout, fanin)], in-degree concentrated near fanin) rather than
+the reference's exact event interleaving -- verified statistically against
+the event-driven oracle in tests/test_overlay.py (SURVEY §7.3 hard part #1).
+
+Quiescence is race-free: a round with zero processed AND zero in-flight
+messages (the reference's polled check can terminate early, SURVEY §5.2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models.state import OverlayState
+from gossip_simulator_tpu.ops.mailbox import deliver
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+
+
+def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
+    n = n_local if n_local is not None else cfg.n
+    k = cfg.max_degree
+    cap = cfg.mailbox_cap_resolved
+    em, eb = cap + 2, cap
+    z = lambda: jnp.zeros((), I32)
+    return OverlayState(
+        friends=jnp.full((n, k), -1, I32),
+        friend_cnt=jnp.zeros((n,), I32),
+        mk_dst=jnp.full((n, em), -1, I32),
+        bk_dst=jnp.full((n, eb), -1, I32),
+        round=z(), makeups=z(), breakups=z(),
+        win_makeups=z(), win_breakups=z(), mailbox_dropped=z(),
+    )
+
+
+def _masked_set(arr, rows, cols, vals, mask):
+    """arr[rows, cols] = vals where mask (scatter with blend)."""
+    cur = arr[rows, cols]
+    return arr.at[rows, cols].set(jnp.where(mask, vals, cur))
+
+
+def make_round_fn(cfg: Config,
+                  deliver_fn=None,
+                  ids_fn=None,
+                  sum_fn=None) -> Callable[[OverlayState, jax.Array], OverlayState]:
+    """Build the per-round transition.
+
+    The three hooks make the same body run single-device or per-shard inside
+    shard_map (parallel/sharded_step.py):
+      deliver_fn(src, dst, valid, cap) -> (mbox int32[n_local, cap], dropped)
+          -- plain local mailbox delivery by default; routed all_to_all
+             delivery when sharded.
+      ids_fn() -> global ids of the local rows (arange(n) by default).
+      sum_fn(x) -> global scalar reduction (identity by default; psum sharded).
+    """
+    n = cfg.n
+    k = cfg.max_degree
+    fanout, fanin = cfg.fanout, cfg.fanin_resolved
+    cap = cfg.mailbox_cap_resolved
+    em, eb = cap + 2, cap
+    if deliver_fn is None:
+        def deliver_fn(src, dst, valid, cap):
+            mbox, _, dropped = deliver(src, dst, valid, n, cap)
+            return mbox, dropped
+    if ids_fn is None:
+        ids_fn = lambda: jnp.arange(n, dtype=I32)
+    if sum_fn is None:
+        sum_fn = lambda x: x
+
+    def round_fn(st: OverlayState, base_key: jax.Array) -> OverlayState:
+        ids = ids_fn()  # GLOBAL ids of local rows (identity comparisons)
+        n_local = ids.shape[0]
+        rows = jnp.arange(n_local, dtype=I32)  # LOCAL row indices (indexing)
+        rkey = jax.random.fold_in(base_key, st.round)
+
+        # --- 1. deliver last round's emissions into mailboxes -------------
+        mk_mbox, drop1 = deliver_fn(
+            jnp.broadcast_to(ids[:, None], (n_local, em)).reshape(-1),
+            st.mk_dst.reshape(-1), st.mk_dst.reshape(-1) >= 0, cap)
+        bk_mbox, drop2 = deliver_fn(
+            jnp.broadcast_to(ids[:, None], (n_local, eb)).reshape(-1),
+            st.bk_dst.reshape(-1), st.bk_dst.reshape(-1) >= 0, cap)
+        dropped = st.mailbox_dropped + sum_fn(drop1 + drop2)
+
+        friends, cnt = st.friends, st.friend_cnt
+        mk_em = jnp.full((n_local, em), -1, I32)
+        bk_em = jnp.full((n_local, eb), -1, I32)
+        win_mk = jnp.zeros((), I32)
+        win_bk = jnp.zeros((), I32)
+
+        # --- 2. process breakup mailbox (slot-sequential, node-parallel) ---
+        # simulator.go:76-94.
+        def bk_body(slot, carry):
+            friends, cnt, mk_em, win_bk = carry
+            src = bk_mbox[:, slot]
+            has = src >= 0
+            in_range = jnp.arange(k, dtype=I32)[None, :] < cnt[:, None]
+            match = (friends == src[:, None]) & in_range & has[:, None]
+            found = match.any(axis=1)
+            pos = jnp.argmax(match, axis=1).astype(I32)  # first match
+            over = cnt > fanout
+            rm = has & found & over
+            rp = has & found & ~over
+            kk = jax.random.fold_in(
+                jax.random.fold_in(rkey, _rng.OP_REPLACE), slot)
+            nf = _rng.randint_excluding(kk, n, (n_local,), src, ids)
+            lastpos = jnp.maximum(cnt - 1, 0)
+            lastval = friends[rows, lastpos]
+            posval = jnp.where(rm, lastval, jnp.where(rp, nf, friends[rows, pos]))
+            friends = friends.at[rows, pos].set(posval)
+            friends = _masked_set(friends, rows, lastpos,
+                                  jnp.full((n_local,), -1, I32), rm)
+            cnt = cnt - rm.astype(I32)
+            mk_em = mk_em.at[:, slot].set(jnp.where(rp, nf, -1))
+            return friends, cnt, mk_em, win_bk + has.sum(dtype=I32)
+
+        friends, cnt, mk_em, win_bk = jax.lax.fori_loop(
+            0, cap, bk_body, (friends, cnt, mk_em, win_bk))
+
+        # --- 3. process makeup mailbox -------------------------------------
+        # simulator.go:66-75.
+        def mk_body(slot, carry):
+            friends, cnt, bk_em, win_mk = carry
+            src = mk_mbox[:, slot]
+            has = src >= 0
+            under = cnt < fanin
+            app = has & under
+            appcol = jnp.minimum(cnt, k - 1)
+            friends = _masked_set(friends, rows, appcol, src, app)
+            cnt = cnt + app.astype(I32)
+            ev = has & ~under
+            kk = jax.random.fold_in(
+                jax.random.fold_in(rkey, _rng.OP_EVICT), slot)
+            vpos = jax.random.randint(kk, (n_local,), 0, jnp.maximum(cnt, 1),
+                                      dtype=I32)
+            victim = friends[rows, vpos]
+            friends = _masked_set(friends, rows, vpos, src, ev)
+            bk_em = bk_em.at[:, slot].set(jnp.where(ev, victim, -1))
+            return friends, cnt, bk_em, win_mk + has.sum(dtype=I32)
+
+        friends, cnt, bk_em, win_mk = jax.lax.fori_loop(
+            0, cap, mk_body, (friends, cnt, bk_em, win_mk))
+
+        # --- 4. bootstrap: one friend per round while under fanout ---------
+        # simulator.go:95-106.
+        kb = jax.random.fold_in(rkey, _rng.OP_BOOTSTRAP)
+        under = cnt < fanout
+        w = jax.random.randint(kb, (n_local,), 0, n, dtype=I32)
+        w = jnp.where(w == ids, (w + 1) % n, w)
+        appcol = jnp.minimum(cnt, k - 1)
+        friends = _masked_set(friends, rows, appcol, w, under)
+        cnt = cnt + under.astype(I32)
+        mk_em = mk_em.at[:, em - 1].set(jnp.where(under, w, -1))
+
+        # Global reductions (psum when sharded): window counts feed both the
+        # progress lines and the quiescence predicate, so they must be the
+        # global sums the reference's atomics would show (simulator.go:224-230).
+        win_mk = sum_fn(win_mk)
+        win_bk = sum_fn(win_bk)
+        return OverlayState(
+            friends=friends, friend_cnt=cnt, mk_dst=mk_em, bk_dst=bk_em,
+            round=st.round + 1,
+            makeups=st.makeups + win_mk, breakups=st.breakups + win_bk,
+            win_makeups=win_mk, win_breakups=win_bk,
+            mailbox_dropped=dropped,
+        )
+
+    return round_fn
+
+
+class OverlayResult(NamedTuple):
+    friends: jnp.ndarray
+    friend_cnt: jnp.ndarray
+    rounds: int
+    makeups: int
+    breakups: int
+    mailbox_dropped: int
+
+
+def pending_emissions(st: OverlayState) -> jnp.ndarray:
+    return (st.mk_dst >= 0).sum(dtype=I32) + (st.bk_dst >= 0).sum(dtype=I32)
+
+
+def quiesced(st: OverlayState) -> jnp.ndarray:
+    """Zero processed this round AND zero in flight (race-free version of
+    simulator.go:221-234).  The round counter guards round 0 (nothing has
+    happened yet)."""
+    return ((st.win_makeups == 0) & (st.win_breakups == 0)
+            & (pending_emissions(st) == 0) & (st.round > 0))

@@ -1,0 +1,52 @@
+"""Struct-of-arrays state pytrees.
+
+The reference's per-node ``Node`` struct (simulator.go:34-46) becomes one
+struct-of-arrays over the node axis; every field shards trivially on that
+axis for the sharded backend.  Counters live on device (int32 -- safe to
+~350M nodes at fanout 5; the reference's int32 atomics have the same bound,
+SURVEY §5.5) and are fetched once per progress window.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class SimState(NamedTuple):
+    """Epidemic-phase state (phase 2).  Node axis = leading axis of 1-D/2-D
+    fields; `pending`/`rebroadcast` are ring buffers over delay ticks."""
+
+    received: jnp.ndarray  # bool[n]   ever infected (simulator.go:38)
+    crashed: jnp.ndarray  # bool[n]    (simulator.go:39)
+    removed: jnp.ndarray  # bool[n]    SIR only; removed => stops forwarding
+    friends: jnp.ndarray  # int32[n, k]  -1-padded adjacency (simulator.go:45)
+    friend_cnt: jnp.ndarray  # int32[n]
+    pending: jnp.ndarray  # int32[d, n]  arrival counts, ring over ticks
+    rebroadcast: jnp.ndarray  # bool[d, n]  SIR re-broadcast schedule
+    tick: jnp.ndarray  # int32[]
+    total_message: jnp.ndarray  # int32[]  (simulator.go:31)
+    total_received: jnp.ndarray  # int32[]  (simulator.go:29)
+    total_crashed: jnp.ndarray  # int32[]  (simulator.go:30)
+    # Framework-only: cross-shard all_to_all bucket overflow (0 on one chip;
+    # counted, never silently lost -- SURVEY §7.3 hard part #4).
+    exchange_overflow: jnp.ndarray  # int32[]
+
+
+class OverlayState(NamedTuple):
+    """Overlay-construction state (phase 1).  Message buffers hold the
+    makeups/breakups emitted this round, delivered next round (the vectorized
+    stand-in for the reference's delayed channel sends, simulator.go:151-164).
+    """
+
+    friends: jnp.ndarray  # int32[n, k]
+    friend_cnt: jnp.ndarray  # int32[n]
+    mk_dst: jnp.ndarray  # int32[n, em]  makeup emissions (dst per slot; src=row)
+    bk_dst: jnp.ndarray  # int32[n, eb]  breakup emissions
+    round: jnp.ndarray  # int32[]
+    makeups: jnp.ndarray  # int32[]  cumulative processed (MakeUps)
+    breakups: jnp.ndarray  # int32[]  (BreakUps)
+    win_makeups: jnp.ndarray  # int32[]  this round's count
+    win_breakups: jnp.ndarray  # int32[]
+    mailbox_dropped: jnp.ndarray  # int32[]  capacity overflow (divergence counter)

@@ -1,0 +1,3 @@
+from gossip_simulator_tpu.ops.mailbox import deliver, segment_ranks
+
+__all__ = ["deliver", "segment_ranks"]

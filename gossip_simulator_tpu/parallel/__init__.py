@@ -1,0 +1,4 @@
+from gossip_simulator_tpu.parallel.mesh import node_mesh, shard_size
+from gossip_simulator_tpu.parallel import exchange
+
+__all__ = ["node_mesh", "shard_size", "exchange"]

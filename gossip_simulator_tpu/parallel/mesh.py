@@ -1,0 +1,37 @@
+"""Device mesh for the node axis.
+
+The reference scales by spawning goroutines inside one process
+(simulator.go:214-217); the TPU framework scales by sharding the node axis
+over a 1-D mesh (SURVEY §2.2 row 4).  One axis ("nodes") is all the
+simulator needs -- collectives ride ICI within a slice; multi-slice DCN works
+through the same axis via jax's standard multi-host initialization.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS = "nodes"
+
+
+def node_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                f"({jax.default_backend()}); for CPU testing set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def shard_size(n: int, mesh: Mesh) -> int:
+    s = mesh.shape[AXIS]
+    if n % s:
+        raise ValueError(
+            f"n ({n}) must be divisible by the mesh size ({s}); "
+            f"pad n or change the device count")
+    return n // s

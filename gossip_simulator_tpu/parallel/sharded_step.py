@@ -1,0 +1,367 @@
+"""SPMD step functions over the node mesh (shard_map + all_to_all + psum).
+
+Each device owns a contiguous slice of the node axis; the per-tick physics is
+the SAME `tick_core` the single-chip backend uses, the outgoing wave is
+routed with one all_to_all (parallel/exchange.py), and the global counters /
+termination predicate are psums -- the TPU-native equivalent of the
+reference's shared `GlobalView` + atomics (simulator.go:24-31).
+
+Layout (S shards, n = S * n_local):
+    received/crashed/removed/friend_cnt: [n]      -> P("nodes")
+    friends:                             [n, k]   -> P("nodes", None)
+    pending/rebroadcast:                 [d, n]   -> P(None, "nodes")
+    tick / totals:                       scalars  -> replicated
+Global node id of local row r on shard s: s * n_local + r.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models import epidemic, graphs, overlay
+from gossip_simulator_tpu.models.state import OverlayState, SimState
+from gossip_simulator_tpu.ops.mailbox import deliver
+from gossip_simulator_tpu.parallel import exchange
+from gossip_simulator_tpu.parallel.mesh import AXIS, shard_size
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+
+
+def sim_state_specs() -> SimState:
+    return SimState(
+        received=P(AXIS), crashed=P(AXIS), removed=P(AXIS),
+        friends=P(AXIS, None), friend_cnt=P(AXIS),
+        pending=P(None, AXIS), rebroadcast=P(None, AXIS),
+        tick=P(), total_message=P(), total_received=P(), total_crashed=P(),
+        exchange_overflow=P(),
+    )
+
+
+def overlay_state_specs() -> OverlayState:
+    return OverlayState(
+        friends=P(AXIS, None), friend_cnt=P(AXIS),
+        mk_dst=P(AXIS, None), bk_dst=P(AXIS, None),
+        round=P(), makeups=P(), breakups=P(),
+        win_makeups=P(), win_breakups=P(), mailbox_dropped=P(),
+    )
+
+
+def _shard_map(mesh, fn, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+# --------------------------------------------------------------------------
+# Epidemic phase
+# --------------------------------------------------------------------------
+
+def _deposit_routed(cfg: Config, n_local: int, n_shards: int, pending,
+                    dst_global, slots, valid, row_width: int):
+    """Route (dst, ring-slot) messages to their owning shards and scatter
+    into the local pending ring.  Returns (pending, local overflow).
+    `row_width` is the friends-array slot count (erdos rows are wider than
+    max_degree; the buffer must cover the real wave)."""
+    d = epidemic.ring_depth(cfg)
+    dest_shard = jnp.where(valid, dst_global // n_local, n_shards)
+    dst_local = jnp.where(valid, dst_global % n_local, 0)
+    packed = jnp.where(valid, exchange.pack_dst_slot(dst_local, slots, d), -1)
+    cap = exchange.epidemic_cap(n_local, row_width, n_shards)
+    recv, overflow = exchange.route_one(packed, dest_shard, valid,
+                                        n_shards, cap)
+    rvalid = recv >= 0
+    rdst, rslot = exchange.unpack_dst_slot(jnp.maximum(recv, 0), d)
+    pending = epidemic.deposit_local(pending, rdst, rslot, rvalid)
+    return pending, overflow
+
+
+def make_sharded_tick(cfg: Config, mesh):
+    """Per-tick transition as a shard_map body (composable into loops)."""
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+
+    def tick_shard(st: SimState, base_key: jax.Array) -> SimState:
+        shard = jax.lax.axis_index(AXIS)
+        keys = epidemic.tick_keys(base_key, st.tick, shard)
+        stp, senders, dslot, (dm, dr, dc) = epidemic.tick_core(cfg, st, keys)
+        dst, slots, valid = epidemic.edges_from_senders(
+            cfg, stp.friends, stp.friend_cnt, senders, dslot, keys["drop"])
+        pending, ovf = _deposit_routed(cfg, n_local, s, stp.pending,
+                                       dst, slots, valid,
+                                       stp.friends.shape[1])
+        dm, dr, dc, ovf = jax.lax.psum((dm, dr, dc, ovf), AXIS)
+        return stp._replace(
+            pending=pending,
+            total_message=stp.total_message + dm,
+            total_received=stp.total_received + dr,
+            total_crashed=stp.total_crashed + dc,
+            exchange_overflow=stp.exchange_overflow + ovf)
+
+    return tick_shard
+
+
+def make_sharded_pushpull(cfg: Config, mesh):
+    """Push-pull anti-entropy round per shard: push deliveries and pull
+    request/response both ride the same all_to_all routing."""
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+    f = cfg.fanout
+    drop_p = epidemic.p_eff(cfg, cfg.droprate)
+    crash_p = epidemic.p_eff(cfg, cfg.crashrate)
+    cap = exchange.epidemic_cap(n_local, f, s)
+
+    def round_shard(st: SimState, base_key: jax.Array) -> SimState:
+        shard = jax.lax.axis_index(AXIS)
+        skey = jax.random.fold_in(base_key, shard)
+        k1 = _rng.tick_key(skey, st.tick, _rng.OP_BOOTSTRAP)
+        k2 = _rng.tick_key(skey, st.tick, _rng.OP_PULL)
+        kd1 = _rng.tick_key(skey, st.tick, _rng.OP_DROP)
+        kd2 = _rng.tick_key(skey, st.tick, _rng.OP_DELAY)
+        kc = _rng.tick_key(skey, st.tick, _rng.OP_CRASH)
+
+        live = ~st.crashed
+        inf = st.received & live
+        sus = ~st.received & live
+        gids = shard * n_local + jnp.arange(n_local, dtype=I32)
+
+        # --- push ---------------------------------------------------------
+        peers = jax.random.randint(k1, (n_local, f), 0, cfg.n, dtype=I32)
+        kept = ~_rng.bernoulli(kd1, drop_p, (n_local, f))
+        edge = (inf[:, None] & kept).reshape(-1)
+        dstg = peers.reshape(-1)
+        recv, ovf1 = exchange.route_one(
+            jnp.where(edge, dstg % n_local, -1),
+            jnp.where(edge, dstg // n_local, s), edge, s, cap)
+        rvalid = recv >= 0
+        arriving = jnp.zeros((n_local,), I32).at[
+            jnp.where(rvalid, recv, n_local)].add(1, mode="drop")
+        counted = jnp.where(live, arriving, 0)
+        dm = counted.sum(dtype=I32)
+        if crash_p > 0.0:
+            pc = 1.0 - jnp.power(1.0 - crash_p, counted.astype(jnp.float32))
+            new_crash = (jax.random.uniform(kc, (n_local,)) < pc) & (counted > 0)
+        else:
+            new_crash = jnp.zeros((n_local,), bool)
+        crashed = st.crashed | new_crash
+        dc = new_crash.sum(dtype=I32)
+        newly_push = (counted > 0) & ~crashed & ~st.received
+
+        # --- pull: request (target, requester) then response (hits) --------
+        peers2 = jax.random.randint(k2, (n_local, f), 0, cfg.n, dtype=I32)
+        kept2 = ~_rng.bernoulli(kd2, drop_p, (n_local, f))
+        req = (sus[:, None] & kept2 & ~crashed[:, None]).reshape(-1)
+        tgt = peers2.reshape(-1)
+        dest = jnp.where(req, tgt // n_local, s)
+        rtgt, ovf2 = exchange.route_one(jnp.where(req, tgt % n_local, -1),
+                                        dest, req, s, cap)
+        rreq, ovf3 = exchange.route_one(
+            jnp.where(req, jnp.broadcast_to(gids[:, None],
+                                            (n_local, f)).reshape(-1), -1),
+            dest, req, s, cap)
+        tvalid = rtgt >= 0
+        tgt_idx = jnp.where(tvalid, rtgt, 0)
+        # A live peer answers any request (counted); an infected live peer's
+        # answer infects.
+        answered = tvalid & ~st.crashed[tgt_idx]
+        dm = dm + answered.sum(dtype=I32)
+        hit = answered & st.received[tgt_idx]
+        back, ovf4 = exchange.route_one(
+            jnp.where(hit, rreq % n_local, -1),
+            jnp.where(hit, rreq // n_local, s), hit, s, cap)
+        bvalid = back >= 0
+        pull_hit = jnp.zeros((n_local,), bool).at[
+            jnp.where(bvalid, back, n_local)].max(bvalid, mode="drop")
+
+        newly = (newly_push | pull_hit) & ~crashed & ~st.received
+        received = st.received | newly
+        dr = newly.sum(dtype=I32)
+        dm, dr, dc = jax.lax.psum((dm, dr, dc), AXIS)
+        ovf = jax.lax.psum(ovf1 + ovf2 + ovf3 + ovf4, AXIS)
+        return st._replace(
+            received=received, crashed=crashed, tick=st.tick + 1,
+            total_message=st.total_message + dm,
+            total_received=st.total_received + dr,
+            total_crashed=st.total_crashed + dc,
+            exchange_overflow=st.exchange_overflow + ovf)
+
+    return round_shard
+
+
+def make_sharded_step(cfg: Config, mesh):
+    if cfg.protocol == "pushpull":
+        return make_sharded_pushpull(cfg, mesh)
+    return make_sharded_tick(cfg, mesh)
+
+
+def make_sharded_seed(cfg: Config, mesh):
+    """Uniform-random global sender; its broadcast is routed like any wave."""
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+
+    def seed_shard(st: SimState, base_key: jax.Array) -> SimState:
+        shard = jax.lax.axis_index(AXIS)
+        ks = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_SEED_NODE)
+        kd = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DELAY)
+        kp = _rng.tick_key(jax.random.fold_in(base_key, shard),
+                           epidemic.SEED_TICK, _rng.OP_DROP)
+        sender = jax.random.randint(ks, (), 0, cfg.n, dtype=I32)
+        gids = shard * n_local + jnp.arange(n_local, dtype=I32)
+        is_sender = gids == sender
+        received, total_received = st.received, st.total_received
+        if cfg.protocol == "pushpull" or not cfg.compat_reference:
+            received = received | is_sender
+            total_received = total_received + 1  # replicated: +1 everywhere
+        if cfg.protocol == "pushpull":
+            return st._replace(received=received,
+                               total_received=total_received)
+        dslot = epidemic._delay_and_slot(cfg, kd, st.tick, ())
+        dslot = jnp.broadcast_to(dslot, (n_local,)).astype(I32)
+        dst, slots, valid = epidemic.edges_from_senders(
+            cfg, st.friends, st.friend_cnt, is_sender, dslot, kp)
+        pending, ovf = _deposit_routed(cfg, n_local, s, st.pending,
+                                       dst, slots, valid,
+                                       st.friends.shape[1])
+        rb = st.rebroadcast
+        if cfg.protocol == "sir":
+            kr = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_REMOVE)
+            keep = ~_rng.bernoulli(kr, epidemic.p_eff(cfg, cfg.removal_rate),
+                                   ())
+            rb = rb.at[dslot, jnp.arange(n_local, dtype=I32)].max(
+                is_sender & keep)
+        ovf = jax.lax.psum(ovf, AXIS)
+        return st._replace(received=received, total_received=total_received,
+                           pending=pending, rebroadcast=rb,
+                           exchange_overflow=st.exchange_overflow + ovf)
+
+    return seed_shard
+
+
+def make_sharded_init(cfg: Config, mesh):
+    """Build the sharded SimState for a static graph directly on the mesh
+    (each shard generates its own row slice; the row-keyed generators make
+    this bit-identical to slicing a single-device generation)."""
+    n_local = shard_size(cfg.n, mesh)
+
+    def init_shard():
+        shard = jax.lax.axis_index(AXIS)
+        key = graphs.graph_key(cfg)
+        friends, cnt = graphs.generate(cfg, key, row0=shard * n_local,
+                                       rows=n_local)
+        return epidemic.init_state(cfg, friends, cnt, n_local=n_local)
+
+    specs = sim_state_specs()
+    fn = _shard_map(mesh, init_shard, in_specs=(), out_specs=specs)
+    return jax.jit(fn)
+
+
+# --------------------------------------------------------------------------
+# Overlay phase (dynamic graph), sharded
+# --------------------------------------------------------------------------
+
+def make_sharded_overlay_round(cfg: Config, mesh):
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+    cap = cfg.mailbox_cap_resolved
+    # Membership messages per node per round <= em/eb; same capacity logic as
+    # the epidemic wave.
+    route_cap = exchange.epidemic_cap(n_local, cap + 2, s)
+
+    def routed_deliver(src, dst, valid, mbox_cap):
+        """Route (src payload) to dst's shard, then local mailbox deliver."""
+        dest = jnp.where(valid, dst // n_local, s)
+        dstl = jnp.where(valid, dst % n_local, 0)
+        rsrc, ovf1 = exchange.route_one(jnp.where(valid, src, -1), dest, valid,
+                                        s, route_cap, )
+        rdst, ovf2 = exchange.route_one(jnp.where(valid, dstl, -1), dest,
+                                        valid, s, route_cap)
+        rvalid = rsrc >= 0
+        mbox, _, dropped = deliver(rsrc, jnp.where(rvalid, rdst, 0), rvalid,
+                                   n_local, mbox_cap)
+        # ovf1 == ovf2 (identical dest/valid keys drive both routes); count
+        # each lost message once.
+        del ovf2
+        return mbox, dropped + ovf1
+
+    def ids_fn():
+        shard = jax.lax.axis_index(AXIS)
+        return shard * n_local + jnp.arange(n_local, dtype=I32)
+
+    def sum_fn(x):
+        return jax.lax.psum(x, AXIS)
+
+    body = overlay.make_round_fn(cfg, deliver_fn=routed_deliver,
+                                 ids_fn=ids_fn, sum_fn=sum_fn)
+
+    def round_shard(st: OverlayState, base_key: jax.Array) -> OverlayState:
+        # Decorrelate per-shard draws inside the round body by folding the
+        # shard id into the key stream.
+        shard = jax.lax.axis_index(AXIS)
+        return body(st, jax.random.fold_in(base_key, shard))
+
+    return round_shard
+
+
+def make_sharded_overlay_init(cfg: Config, mesh):
+    n_local = shard_size(cfg.n, mesh)
+
+    def init_shard():
+        return overlay.init_state(cfg, n_local=n_local)
+
+    return jax.jit(_shard_map(mesh, init_shard, in_specs=(),
+                              out_specs=overlay_state_specs()))
+
+
+# --------------------------------------------------------------------------
+# Jitted drivers (loops live inside one shard_map region)
+# --------------------------------------------------------------------------
+
+def make_window_fn(cfg: Config, mesh, window: int):
+    step = make_sharded_step(cfg, mesh)
+    specs = sim_state_specs()
+
+    def window_shard(st: SimState, base_key: jax.Array) -> SimState:
+        return jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), st)
+
+    return jax.jit(_shard_map(mesh, window_shard, in_specs=(specs, P()),
+                              out_specs=specs))
+
+
+def make_seed_fn(cfg: Config, mesh):
+    specs = sim_state_specs()
+    return jax.jit(_shard_map(mesh, make_sharded_seed(cfg, mesh),
+                              in_specs=(specs, P()), out_specs=specs))
+
+
+def make_overlay_round_fn(cfg: Config, mesh):
+    specs = overlay_state_specs()
+    return jax.jit(_shard_map(mesh, make_sharded_overlay_round(cfg, mesh),
+                              in_specs=(specs, P()), out_specs=specs))
+
+
+def make_run_to_coverage_fn(cfg: Config, mesh):
+    step = make_sharded_step(cfg, mesh)
+    specs = sim_state_specs()
+    window = 1 if cfg.effective_time_mode == "rounds" else 10
+    max_steps = cfg.max_rounds
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def run(st: SimState, base_key: jax.Array, target_count: int) -> SimState:
+        def run_shard(st, base_key):
+            def cond(s):
+                return (s.total_received < target_count) & (s.tick < max_steps)
+
+            def body(s):
+                return jax.lax.fori_loop(
+                    0, window, lambda _, x: step(x, base_key), s)
+
+            return jax.lax.while_loop(cond, body, st)
+
+        return _shard_map(mesh, run_shard, in_specs=(specs, P()),
+                          out_specs=specs)(st, base_key)
+
+    return run

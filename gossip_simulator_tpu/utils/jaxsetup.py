@@ -1,0 +1,24 @@
+"""Process-level JAX knobs (compilation cache).
+
+This environment compiles through a remote relay, so even trivial jits cost
+seconds of wall-clock; the persistent compilation cache makes every rerun of
+the same (config, shape) free.  Call before the first jit -- cli.py, bench.py
+and tests/conftest.py all route through here.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+
+def setup(cache_dir: str | None = None) -> None:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          cache_dir or _DEFAULT_CACHE)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

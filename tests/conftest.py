@@ -1,0 +1,37 @@
+"""Test env: CPU backend with 8 fake devices (SURVEY §4.3) + persistent
+compilation cache.
+
+This image's sitecustomize registers the axon TPU PJRT plugin at interpreter
+startup, which initializes the JAX backend before any conftest runs -- making
+`--xla_force_host_platform_device_count` / `jax_num_cpu_devices` no-ops.  So
+we re-exec pytest once with the axon hook disabled (PALLAS_AXON_POOL_IPS="")
+and the CPU fake-mesh env in place.  The re-exec happens in pytest_configure
+-- after stopping pytest's fd-level capture, which would otherwise swallow
+the child's output.
+"""
+
+import os
+import sys
+
+
+def pytest_configure(config):
+    if os.environ.get("_GOSSIP_TEST_REEXEC") == "1":
+        from gossip_simulator_tpu.utils import jaxsetup
+
+        jaxsetup.setup()
+        return
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = dict(os.environ)
+    env["_GOSSIP_TEST_REEXEC"] = "1"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon PJRT registration
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]],
+              env)

@@ -1,0 +1,55 @@
+"""CLI output-surface parity (SURVEY §0 outputs 1-4)."""
+
+import io
+import re
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+
+def _capture(**kw):
+    kw.setdefault("backend", "native")
+    cfg = Config(**kw).validate()
+    buf = io.StringIO()
+    run_simulation(cfg, printer=ProgressPrinter(enabled=True, out=buf))
+    return buf.getvalue()
+
+
+def test_output_surface_matches_reference_format():
+    out = _capture(n=1500, seed=1)
+    # 1. parameter dump (simulator.go:197-204)
+    assert out.startswith("=== Parameters ===\n")
+    assert "delaylow=10ms" in out and "delayhigh=20ms" in out
+    # 2. overlay progress + stabilization (simulator.go:230,235)
+    assert re.search(r"break \d+ makeup \d+ elasped \d+", out)
+    assert re.search(r"--- Took \S+ to stabilize ---", out)
+    # 3. coverage lines + time-to-99 (simulator.go:247,252)
+    assert re.search(r"[\d.]+% covered, took \S+", out)
+    assert re.search(r"--- Took \S+ to get 99% ---", out)
+    # 4. final totals (simulator.go:253)
+    assert re.search(r"Total message \d+ Total Crashed \d+", out)
+
+
+def test_sections_present():
+    out = _capture(n=1500, seed=1)
+    assert "=== Constructing Overlay ===" in out
+    assert "=== Broadcast one message ===" in out
+
+
+def test_nonconvergence_reported():
+    out = _capture(n=1500, seed=1, droprate=0.97, max_rounds=300,
+                   graph="kout", crashrate=0.0)
+    assert "Did NOT reach" in out
+
+
+def test_jsonl_log(tmp_path):
+    p = tmp_path / "log.jsonl"
+    cfg = Config(n=1500, seed=1, backend="native").validate()
+    run_simulation(cfg, printer=ProgressPrinter(enabled=False,
+                                                jsonl_path=str(p)))
+    import json
+
+    events = [json.loads(l) for l in p.read_text().splitlines()]
+    kinds = {e["event"] for e in events}
+    assert {"params", "coverage", "done", "totals"} <= kinds

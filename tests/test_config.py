@@ -1,0 +1,75 @@
+"""Config / CLI parity tests (reference flags: simulator.go:186-205)."""
+
+import pytest
+
+from gossip_simulator_tpu.config import Config, parse_args
+
+
+def test_defaults_match_reference():
+    # simulator.go:187-193
+    c = Config().validate()
+    assert (c.n, c.fanout, c.delaylow, c.delayhigh) == (50_000, 5, 10, 20)
+    assert (c.droprate, c.crashrate) == (0.1, 0.001)
+    assert c.fanin_resolved == 6  # fanout+1 resolved
+
+
+def test_fanin_default_tracks_fanout_unless_compat():
+    # Divergence from the reference's constant-6 quirk (simulator.go:189).
+    assert Config(fanout=10).fanin_resolved == 11
+    assert Config(fanout=10, compat_reference=True).fanin_resolved == 6
+    assert Config(fanout=10, fanin=4).fanin_resolved == 4
+
+
+def test_max_degree():
+    assert Config(fanout=5).max_degree == 6
+    assert Config(fanout=10, fanin=4).max_degree == 10
+
+
+@pytest.mark.parametrize("kw", [
+    dict(delaylow=10, delayhigh=5),   # reference panics here (simulator.go:167)
+    dict(delaylow=10, delayhigh=10),
+    dict(droprate=1.5),
+    dict(crashrate=-0.1),
+    dict(n=1),
+    dict(n=2),                        # overlay needs >= 3
+    dict(fanout=0),
+    dict(backend="cuda"),
+    dict(protocol="blorp"),
+    dict(coverage_target=0.0),
+    dict(n=5, fanout=5),
+])
+def test_validation_rejects(kw):
+    with pytest.raises(ValueError):
+        Config(**kw).validate()
+
+
+def test_parameter_dump_format():
+    # simulator.go:197-204: alphabetical flag dump, ms suffix on delays.
+    dump = Config().parameter_dump().splitlines()
+    assert dump[0] == "=== Parameters ==="
+    assert dump[1:] == [
+        "crashrate=0.001", "delayhigh=20ms", "delaylow=10ms", "droprate=0.1",
+        "fanin=6", "fanout=5", "n=50000",
+    ]
+
+
+def test_parse_args_single_dash_go_style():
+    c = parse_args(["-n", "1000", "-fanout", "3", "-droprate", "0.2",
+                    "-backend", "native", "-seed", "42"])
+    assert (c.n, c.fanout, c.droprate, c.backend, c.seed) == \
+        (1000, 3, 0.2, "native", 42)
+    assert c.progress
+
+
+def test_parse_args_quiet_and_extensions():
+    c = parse_args(["-quiet", "-protocol", "sir", "-removal-rate", "0.25",
+                    "-graph", "erdos", "-time-mode", "rounds", "-backend",
+                    "native"])
+    assert not c.progress
+    assert (c.protocol, c.removal_rate, c.graph, c.time_mode) == \
+        ("sir", 0.25, "erdos", "rounds")
+
+
+def test_effective_time_mode_pushpull_is_rounds():
+    assert Config(protocol="pushpull").effective_time_mode == "rounds"
+    assert Config(protocol="si").effective_time_mode == "ticks"

@@ -1,0 +1,60 @@
+"""C++ event-driven backend: build, run, and cross-check against the Python
+oracle distributionally (same algorithm, independent implementations/RNGs)."""
+
+import math
+import shutil
+
+import pytest
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no g++ in PATH")
+
+
+def _run(**kw):
+    kw.setdefault("backend", "cpp")
+    kw.setdefault("progress", False)
+    cfg = Config(**kw).validate()
+    return run_simulation(cfg, printer=ProgressPrinter(enabled=False)), cfg
+
+
+def test_cpp_si_end_to_end():
+    res, cfg = _run(n=20000, seed=1)
+    assert res.converged
+    # overlay degree in [fanout, fanin] => messages in [R*f*(1-d)*0.7, R*fin*(1-d)]
+    r = res.stats.total_received
+    assert res.stats.total_message <= r * cfg.fanin_resolved * (1 - cfg.droprate) * 1.02
+    assert res.stats.total_crashed > 0  # exact-float crash draws at 0.001
+
+
+def test_cpp_matches_python_oracle():
+    rc, cfg = _run(n=4000, seed=5, graph="kout", fanout=6, crashrate=0.0)
+    rp, _ = _run(n=4000, seed=5, graph="kout", fanout=6, crashrate=0.0,
+                 backend="native")
+    assert rc.converged and rp.converged
+    expect = cfg.n * cfg.fanout * (1 - cfg.droprate)
+    assert abs(rc.stats.total_message - rp.stats.total_message) / expect < 0.1
+    assert abs(rc.coverage_ms - rp.coverage_ms) <= 20
+
+
+def test_cpp_compat_truncation():
+    res, _ = _run(n=5000, seed=2, compat_reference=True)
+    assert res.stats.total_crashed == 0
+
+
+def test_cpp_protocol_variants():
+    res, _ = _run(n=5000, seed=3, protocol="pushpull", graph="kout", fanout=4,
+                  max_rounds=60)
+    assert res.converged
+    res, _ = _run(n=5000, seed=3, protocol="sir", graph="kout", fanout=6,
+                  removal_rate=0.3, crashrate=0.0, max_rounds=4000)
+    assert res.converged
+
+
+def test_cpp_determinism():
+    r1, _ = _run(n=3000, seed=7)
+    r2, _ = _run(n=3000, seed=7)
+    assert r1.stats == r2.stats

@@ -1,0 +1,52 @@
+"""Static graph generators (models/graphs.py)."""
+
+import numpy as np
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models import graphs
+
+
+def _cfg(**kw):
+    kw.setdefault("n", 2000)
+    kw.setdefault("backend", "jax")
+    return Config(**kw).validate()
+
+
+def test_kout_shape_and_no_self_loops():
+    cfg = _cfg(graph="kout", fanout=4)
+    f, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
+    assert f.shape == (2000, 4)
+    assert (np.asarray(cnt) == 4).all()
+    ids = np.arange(2000)[:, None]
+    fa = np.asarray(f)
+    assert (fa != ids).all()
+    assert ((fa >= 0) & (fa < 2000)).all()
+
+
+def test_erdos_degree_distribution():
+    cfg = _cfg(graph="erdos", fanout=8)  # lambda = 8
+    f, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
+    deg = np.asarray(cnt)
+    lam = 8.0
+    assert abs(deg.mean() - lam) < 4 * np.sqrt(lam / 2000)
+    fa = np.asarray(f)
+    slot = np.arange(fa.shape[1])[None, :]
+    assert (fa[slot < deg[:, None]] >= 0).all()
+    assert (fa[slot >= deg[:, None]] == -1).all()
+
+
+def test_ring_is_deterministic_lattice():
+    cfg = _cfg(graph="ring", fanout=3)
+    f, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
+    fa = np.asarray(f)
+    np.testing.assert_array_equal(fa[0], [1, 2, 3])
+    np.testing.assert_array_equal(fa[1999], [0, 1, 2])
+
+
+def test_sharded_rows_match_full_generation():
+    # Generating a row slice must equal the same rows of the full graph.
+    cfg = _cfg(graph="kout", fanout=4)
+    key = graphs.graph_key(cfg)
+    full, _ = graphs.generate(cfg, key)
+    part, _ = graphs.generate(cfg, key, row0=700, rows=300)
+    np.testing.assert_array_equal(np.asarray(full)[700:1000], np.asarray(part))
