@@ -68,6 +68,17 @@ class Config:
     compat_reference: bool = False
     # Mailbox / exchange capacities (see ops/mailbox.py).  -1 -> auto.
     mailbox_cap: int = -1
+    # Use the Pallas TPU-PRNG graph generator (ops/pallas_graph.py) for the
+    # kout graph: same distribution, different stream, much faster at 100M.
+    pallas: bool = False
+    # Wavefront compaction: gather only actual senders' edges before the
+    # scatter/route (identical results while no exchange overflow occurs --
+    # overflow is counted, never silent; big win in ticks mode where the
+    # per-tick wave is a small fraction of n).  "auto" = on for ticks mode.
+    compact: str = "auto"
+    # Compaction chunk size override (-1 = auto: n_local//4, min 1024).
+    # Exposed mainly so tests can force the multi-chunk path at small n.
+    compact_chunk: int = -1
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
@@ -108,6 +119,13 @@ class Config:
         return "rounds" if self.protocol == "pushpull" else self.time_mode
 
     @property
+    def compact_resolved(self) -> bool:
+        if self.compact == "auto":
+            return (self.effective_time_mode == "ticks"
+                    and self.protocol != "pushpull")
+        return self.compact == "on"
+
+    @property
     def mailbox_cap_resolved(self) -> int:
         if self.mailbox_cap > 0:
             return self.mailbox_cap
@@ -146,6 +164,9 @@ class Config:
             )
         if self.graph not in GRAPHS:
             raise ValueError(f"graph must be one of {GRAPHS}, got {self.graph!r}")
+        if self.compact not in ("auto", "on", "off"):
+            raise ValueError(
+                f"compact must be auto|on|off, got {self.compact!r}")
         if self.time_mode not in TIME_MODES:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"
@@ -219,6 +240,9 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-er-p", "--er-p", dest="er_p", type=float, default=d.er_p)
     p.add_argument("-compat-reference", "--compat-reference",
                    dest="compat_reference", action="store_true")
+    p.add_argument("-pallas", "--pallas", action="store_true")
+    p.add_argument("-compact", "--compact", choices=("auto", "on", "off"),
+                   default="auto")
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

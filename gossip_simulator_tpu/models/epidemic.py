@@ -167,6 +167,59 @@ def edges_from_senders(cfg: Config, friends, friend_cnt, senders, dslot,
     return dst, slots, edge.reshape(-1)
 
 
+def compact_chunk_cap(cfg: Config, n_local: int) -> int:
+    """Static sender-compaction chunk size.  In ticks mode the per-tick wave
+    is spread over the delay window, so n/4 covers the peak with the chunked
+    loop as a correctness backstop; rounds mode processes everything at once."""
+    if cfg.compact_chunk > 0:
+        return min(n_local, cfg.compact_chunk)
+    if cfg.effective_time_mode == "rounds":
+        return n_local
+    return min(n_local, max(1024, n_local // 4))
+
+
+def compact_gather(friends, friend_cnt, dslot, drop, remaining, cap):
+    """Pull the next <=cap sender rows out of `remaining` and return their
+    edge list (dst, slot, valid) plus the updated remaining mask.  Fill rows
+    (index n) gather as invalid.  Bit-identical to the dense path because the
+    caller drew `drop` densely."""
+    n, k = friends.shape
+    idx = jnp.nonzero(remaining, size=cap, fill_value=n)[0].astype(I32)
+    hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+    remaining = remaining & ~hit
+    sf = friends.at[idx].get(mode="fill", fill_value=-1)
+    scnt = friend_cnt.at[idx].get(mode="fill", fill_value=0)
+    sdrop = drop.at[idx].get(mode="fill", fill_value=True)
+    sslot = dslot.at[idx].get(mode="fill", fill_value=0)
+    edge = (jnp.arange(k, dtype=I32)[None, :] < scnt[:, None]) \
+        & ~sdrop & (sf >= 0)
+    dst = jnp.where(edge, sf, -1).reshape(-1)
+    slots = jnp.broadcast_to(sslot[:, None], (cap, k)).reshape(-1)
+    return dst, slots, edge.reshape(-1), remaining
+
+
+def deposit_compact(cfg: Config, pending, friends, friend_cnt, senders, dslot,
+                    drop_key):
+    """Compacted equivalent of edges_from_senders + deposit_local: only
+    actual sender rows reach the gather/scatter.  The Bernoulli drop mask is
+    still drawn densely with the same key, so the simulation trajectory is
+    bit-identical to the dense path (tested)."""
+    n, k = friends.shape
+    drop = _rng.bernoulli(drop_key, p_eff(cfg, cfg.droprate), (n, k))
+    cap = compact_chunk_cap(cfg, n)
+    count = senders.sum(dtype=I32)
+    chunks = (count + cap - 1) // cap
+
+    def body(_, carry):
+        pending, remaining = carry
+        dst, slots, valid, remaining = compact_gather(
+            friends, friend_cnt, dslot, drop, remaining, cap)
+        return deposit_local(pending, dst, slots, valid), remaining
+
+    pending, _ = jax.lax.fori_loop(0, chunks, body, (pending, senders))
+    return pending
+
+
 def deposit_local(pending, dst_local, slots, valid):
     """Scatter arrivals into the pending ring (idempotent counting add;
     duplicates accumulate like the reference's per-message channel sends)."""
@@ -181,9 +234,15 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     def tick_fn(st: SimState, base_key: jax.Array) -> SimState:
         keys = tick_keys(base_key, st.tick)
         stp, senders, dslot, (dm, dr, dc) = tick_core(cfg, st, keys)
-        dst, slots, valid = edges_from_senders(
-            cfg, stp.friends, stp.friend_cnt, senders, dslot, keys["drop"])
-        pending = deposit_local(stp.pending, dst, slots, valid)
+        if cfg.compact_resolved:
+            pending = deposit_compact(cfg, stp.pending, stp.friends,
+                                      stp.friend_cnt, senders, dslot,
+                                      keys["drop"])
+        else:
+            dst, slots, valid = edges_from_senders(
+                cfg, stp.friends, stp.friend_cnt, senders, dslot,
+                keys["drop"])
+            pending = deposit_local(stp.pending, dst, slots, valid)
         return stp._replace(
             pending=pending,
             total_message=stp.total_message + dm,

@@ -36,6 +36,17 @@ def kout(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None):
     (duplicates allowed, like the reference's bootstrap)."""
     n, k = cfg.n, cfg.fanout
     rows = n if rows is None else rows
+    if cfg.pallas and isinstance(row0, int):
+        # row0 must be a concrete (static) offset: inside shard_map it is a
+        # traced axis_index, where we fall through to the fold_in generator
+        # (the Pallas path currently serves the single-device backends).
+        from gossip_simulator_tpu.ops.pallas_graph import (
+            BLOCK_ROWS, kout_pallas)
+
+        if k <= 128 and row0 % BLOCK_ROWS == 0:
+            interpret = jax.default_backend() != "tpu"
+            friends = kout_pallas(n, k, row0, rows, cfg.seed, interpret)
+            return friends, jnp.full((rows,), k, dtype=jnp.int32)
     ids = (row0 + jnp.arange(rows, dtype=jnp.int32))[:, None]
     keys = _row_keys(key, row0, rows)
     picks = jax.vmap(

@@ -1,0 +1,73 @@
+"""Pallas TPU kernel: k-out random-graph generation with the hardware PRNG.
+
+The default generator (models/graphs.py) derives one counter-based key per row
+(`vmap(fold_in)`) -- exactly reproducible anywhere, but at 100M nodes that is
+10^8 threefry hashes before the simulation starts.  This kernel instead seeds
+the per-core TPU PRNG once per row-block and materializes the friends table
+tile by tile in VMEM (`pltpu.prng_random_bits`), which is bandwidth-bound
+rather than hash-bound.
+
+Properties:
+* Shard-consistent at block granularity: blocks are addressed by GLOBAL row
+  block index, so any shard whose row range is block-aligned generates
+  exactly the rows it owns (same values as a single-device run).
+* Different stream than the default generator -- same seed gives a different
+  (equally random) graph; selected explicitly via `-pallas`.
+* Peer draw is `bits mod n`: modulo bias <= n / 2^32 (< 2.5% at n=100M,
+  uniform over peers to ~1e-9 relative -- irrelevant for the simulation's
+  statistics).  Self-collisions get the reference's (id+1) % n patch
+  (simulator.go:98-100).
+
+Off-TPU (tests) runs under pltpu.InterpretParams -- same semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_ROWS = 512
+LANES = 128  # minimum last-dim tile; k columns are sliced out afterwards
+
+
+def _kout_kernel(n: int, row0: int, seed_ref, out_ref):
+    blk = pl.program_id(0)
+    # Seed by GLOBAL block index so a row0>0 slice reproduces exactly the
+    # same rows as the corresponding blocks of a full generation.
+    pltpu.prng_seed(seed_ref[0], row0 // BLOCK_ROWS + blk)
+    bits = pltpu.prng_random_bits((BLOCK_ROWS, LANES))
+    peers = (bits.astype(jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
+    gid = (row0 + blk * BLOCK_ROWS
+           + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_ROWS, LANES), 0))
+    out_ref[:] = jnp.where(peers == gid, (peers + 1) % n, peers)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 5))
+def kout_pallas(n: int, k: int, row0: int, rows: int, seed,
+                interpret: bool = False):
+    """friends int32[rows, k]: each of rows nodes picks k uniform peers != self.
+
+    Requires k <= 128 and row0 % BLOCK_ROWS == 0 (shard alignment); `rows` is
+    padded up to a block multiple internally.
+    """
+    if k > LANES:
+        raise ValueError(f"kout_pallas supports k <= {LANES}, got {k}")
+    if row0 % BLOCK_ROWS:
+        raise ValueError(f"row0 must be {BLOCK_ROWS}-aligned, got {row0}")
+    nblocks = -(-rows // BLOCK_ROWS)
+    seed_arr = jnp.asarray(seed, dtype=jnp.int32).reshape((1,))
+    out = pl.pallas_call(
+        functools.partial(_kout_kernel, n, row0),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nblocks * BLOCK_ROWS, LANES),
+                                       jnp.int32),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed_arr)
+    return out[:rows, :k]

@@ -62,16 +62,15 @@ def _shard_map(mesh, fn, in_specs, out_specs):
 # --------------------------------------------------------------------------
 
 def _deposit_routed(cfg: Config, n_local: int, n_shards: int, pending,
-                    dst_global, slots, valid, row_width: int):
+                    dst_global, slots, valid, cap: int):
     """Route (dst, ring-slot) messages to their owning shards and scatter
     into the local pending ring.  Returns (pending, local overflow).
-    `row_width` is the friends-array slot count (erdos rows are wider than
-    max_degree; the buffer must cover the real wave)."""
+    `cap` is the per-destination-shard buffer size (exchange.epidemic_cap of
+    the wave's row count x row width)."""
     d = epidemic.ring_depth(cfg)
     dest_shard = jnp.where(valid, dst_global // n_local, n_shards)
     dst_local = jnp.where(valid, dst_global % n_local, 0)
     packed = jnp.where(valid, exchange.pack_dst_slot(dst_local, slots, d), -1)
-    cap = exchange.epidemic_cap(n_local, row_width, n_shards)
     recv, overflow = exchange.route_one(packed, dest_shard, valid,
                                         n_shards, cap)
     rvalid = recv >= 0
@@ -89,11 +88,40 @@ def make_sharded_tick(cfg: Config, mesh):
         shard = jax.lax.axis_index(AXIS)
         keys = epidemic.tick_keys(base_key, st.tick, shard)
         stp, senders, dslot, (dm, dr, dc) = epidemic.tick_core(cfg, st, keys)
-        dst, slots, valid = epidemic.edges_from_senders(
-            cfg, stp.friends, stp.friend_cnt, senders, dslot, keys["drop"])
-        pending, ovf = _deposit_routed(cfg, n_local, s, stp.pending,
-                                       dst, slots, valid,
-                                       stp.friends.shape[1])
+        width = stp.friends.shape[1]
+        if cfg.compact_resolved:
+            # Compacted wave: only sender rows reach the sort/all_to_all.
+            # Chunk count is agreed across shards (pmax) so every shard
+            # executes the same number of collectives.
+            ccap = epidemic.compact_chunk_cap(cfg, n_local)
+            drop = _rng.bernoulli(keys["drop"],
+                                  epidemic.p_eff(cfg, cfg.droprate),
+                                  (n_local, width))
+            count = jax.lax.pmax(senders.sum(dtype=I32), AXIS)
+            chunks = (count + ccap - 1) // ccap
+            # Per-chunk route cap: never below the dense path's (so any wave
+            # dense delivers losslessly, compact does too -- skew included),
+            # bounded above by a chunk's absolute max emission.
+            rcap = min(exchange.epidemic_cap(n_local, width, s), ccap * width)
+
+            def body(_, carry):
+                pending, remaining, ovf = carry
+                dstg, slots, valid, remaining = epidemic.compact_gather(
+                    stp.friends, stp.friend_cnt, dslot, drop, remaining, ccap)
+                pending, o = _deposit_routed(cfg, n_local, s, pending,
+                                             dstg, slots, valid, rcap)
+                return pending, remaining, ovf + o
+
+            pending, _, ovf = jax.lax.fori_loop(
+                0, chunks, body,
+                (stp.pending, senders, jnp.zeros((), I32)))
+        else:
+            dst, slots, valid = epidemic.edges_from_senders(
+                cfg, stp.friends, stp.friend_cnt, senders, dslot,
+                keys["drop"])
+            pending, ovf = _deposit_routed(
+                cfg, n_local, s, stp.pending, dst, slots, valid,
+                exchange.epidemic_cap(n_local, width, s))
         dm, dr, dc, ovf = jax.lax.psum((dm, dr, dc, ovf), AXIS)
         return stp._replace(
             pending=pending,
@@ -223,9 +251,9 @@ def make_sharded_seed(cfg: Config, mesh):
         dslot = jnp.broadcast_to(dslot, (n_local,)).astype(I32)
         dst, slots, valid = epidemic.edges_from_senders(
             cfg, st.friends, st.friend_cnt, is_sender, dslot, kp)
-        pending, ovf = _deposit_routed(cfg, n_local, s, st.pending,
-                                       dst, slots, valid,
-                                       st.friends.shape[1])
+        pending, ovf = _deposit_routed(
+            cfg, n_local, s, st.pending, dst, slots, valid,
+            exchange.epidemic_cap(n_local, st.friends.shape[1], s))
         rb = st.rebroadcast
         if cfg.protocol == "sir":
             kr = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_REMOVE)
