@@ -43,11 +43,11 @@ def _bench_jax(cfg: Config) -> dict:
     jax.block_until_ready(s.state.friends)
     graph_s = time.perf_counter() - t0
     s.seed()
-    # Warm-up: compile + one full run, then rewind state and time a clean run
-    # with the executable cached.
-    state0 = s.state
+    # Warm-up: compile + one full run, then rebuild state (the run donated
+    # the old buffers) and time a clean run with the executable cached.
     s.run_to_target()
-    s.state = state0
+    s.reset_state()
+    s.seed()
     t0 = time.perf_counter()
     stats = s.run_to_target()
     run_s = time.perf_counter() - t0

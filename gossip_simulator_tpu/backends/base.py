@@ -72,3 +72,37 @@ class Stepper(abc.ABC):
 
     def load_state_pytree(self, tree) -> None:
         raise NotImplementedError(f"{self.name} does not support checkpoint restore")
+
+
+def run_bounded_to_target(stepper) -> Stats:
+    """Shared host loop for the JAX backends' run_to_target fast path.
+
+    Re-enters the backend's bounded device-side while_loop (`_run_fn`, see
+    epidemic.run_call_budget) until the coverage target, max_rounds, or
+    exhaustion (nothing in flight -- the liveness bound the reference lacks,
+    simulator.go:243-251).  Requires `stepper._run_fn(state, key, target,
+    until) -> state` with donated state, plus `.state/.key/.exhausted`.
+    """
+    import jax
+    import numpy as np
+
+    cfg = stepper.cfg
+    from gossip_simulator_tpu.models import epidemic
+
+    target = int(np.ceil(cfg.coverage_target * cfg.n))
+    budget = epidemic.run_call_budget(cfg)
+    tick = int(jax.device_get(stepper.state.tick))
+    while True:
+        until = min(cfg.max_rounds, tick + budget)
+        stepper.state = stepper._run_fn(stepper.state, stepper.key,
+                                        np.int32(target), np.int32(until))
+        st = stepper.state
+        tick, recv, in_flight = (int(x) for x in jax.device_get(
+            (st.tick, st.total_received,
+             st.pending.sum() + st.rebroadcast.sum())))
+        if recv >= target or tick >= cfg.max_rounds:
+            break
+        if in_flight == 0 and cfg.protocol != "pushpull":
+            stepper.exhausted = True
+            break
+    return stepper.stats()

@@ -78,12 +78,23 @@ class JaxStepper(Stepper):
         self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
         return stats
 
+    def reset_state(self) -> None:
+        """Rebuild phase-2 state from scratch (same seed => same trajectory)
+        without re-tracing the jitted step functions.  Needed after a run:
+        the hot fns donate their input buffers, so the old state is gone."""
+        cfg = self.cfg
+        if cfg.graph == "overlay":
+            raise ValueError("reset_state requires a static graph")
+        friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
+        self.state = epidemic.init_state(cfg, friends, cnt)
+        self.exhausted = False
+
     def run_to_target(self) -> Stats:
-        """Bench fast path: device-side while_loop to the coverage target."""
-        target = int(np.ceil(self.cfg.coverage_target * self.cfg.n))
-        self.state = self._run_fn(self.state, self.key, target)
-        jax.block_until_ready(self.state.total_received)
-        return self.stats()
+        """Bench fast path: bounded device-side while_loop toward the
+        coverage target (base.run_bounded_to_target)."""
+        from gossip_simulator_tpu.backends.base import run_bounded_to_target
+
+        return run_bounded_to_target(self)
 
     def stats(self) -> Stats:
         st = self.state

@@ -49,7 +49,8 @@ class ShardedStepper(Stepper):
             self._overlay_done = False
             self.state = None
         else:
-            self.state = sharded_step.make_sharded_init(cfg, self.mesh)()
+            self._init_fn = sharded_step.make_sharded_init(cfg, self.mesh)
+            self.state = self._init_fn()
             self._overlay_done = True
 
     # --- phase 1 ---------------------------------------------------------------
@@ -95,11 +96,19 @@ class ShardedStepper(Stepper):
         self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
         return stats
 
+    def reset_state(self) -> None:
+        """Rebuild phase-2 state (same seed => same trajectory) without
+        re-tracing; the hot fns donate their inputs (see JaxStepper)."""
+        if self.cfg.graph == "overlay":
+            raise ValueError("reset_state requires a static graph")
+        self.state = self._init_fn()
+        self.exhausted = False
+
     def run_to_target(self) -> Stats:
-        target = int(np.ceil(self.cfg.coverage_target * self.cfg.n))
-        self.state = self._run_fn(self.state, self.key, target)
-        jax.block_until_ready(self.state.total_received)
-        return self.stats()
+        """Bounded device-side while_loop (base.run_bounded_to_target)."""
+        from gossip_simulator_tpu.backends.base import run_bounded_to_target
+
+        return run_bounded_to_target(self)
 
     def stats(self) -> Stats:
         st = self.state

@@ -76,8 +76,9 @@ class Config:
     # overflow is counted, never silent; big win in ticks mode where the
     # per-tick wave is a small fraction of n).  "auto" = on for ticks mode.
     compact: str = "auto"
-    # Compaction chunk size override (-1 = auto: n_local//4, min 1024).
-    # Exposed mainly so tests can force the multi-chunk path at small n.
+    # Compaction chunk size override (-1 = auto: n_local//128, min 4096; see
+    # epidemic.compact_chunk_cap).  Exposed mainly so tests can force the
+    # multi-chunk path at small n.
     compact_chunk: int = -1
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False

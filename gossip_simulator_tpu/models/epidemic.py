@@ -72,12 +72,63 @@ def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
     )
 
 
-def _delay_and_slot(cfg: Config, key, tick, shape):
+def row_slot(cfg: Config, delay_key, tick, rows):
+    """Delay-ring slot for each row's broadcast this tick.  Row-keyed
+    (utils/rng.row_keys): row r's shared per-broadcast delay
+    (simulator.go:141-142) depends only on (delay_key, r), so the compaction
+    path can draw delays for just the gathered sender rows and land on
+    exactly the dense path's values."""
     d = ring_depth(cfg)
     if cfg.effective_time_mode == "rounds":
-        return (tick + 1) % d
-    delay = _rng.uniform_delay(key, cfg.delaylow, cfg.delayhigh, shape)
-    return (tick + delay) % d
+        return jnp.broadcast_to((tick + 1) % d, rows.shape).astype(I32)
+    delay = _rng.row_uniform_delay(delay_key, cfg.delaylow, cfg.delayhigh,
+                                   rows)
+    return ((tick + delay) % d).astype(I32)
+
+
+def first_true_indices(mask: jnp.ndarray, cap: int,
+                       blk: int | None = None) -> jnp.ndarray:
+    """First <=cap indices of True in `mask`, ascending, padded with n.
+
+    Drop-in for ``jnp.nonzero(mask, size=cap, fill_value=n)[0]``, which XLA
+    lowers to a full-length cumsum + scatter (~150 ms at n=1e7 on TPU v5e --
+    the measured hot op of the compact tick).  Two-level version: one O(n)
+    block-count pass, a nonzero over the n/blk block counts, then gather +
+    in-block scan of only the first `cap` nonempty blocks.
+
+    Yield contract (what deposit_compact's fixed chunk count relies on):
+    if cap blocks are selected each holds >=1 True, and if every nonempty
+    block is selected (nb <= cap) all Trues are seen -- either way the call
+    yields min(cap, count) indices.
+
+    `blk` balances the two scans: the block-count nonzero touches n/blk
+    elements, the candidate gather touches min(nb, cap) * blk; blk ~
+    sqrt(n/cap) equalizes them (both ~sqrt(n*cap)), clamped to [8, 256].
+    """
+    n = mask.shape[0]
+    if n <= 4096 or cap >= n:
+        return jnp.nonzero(mask, size=cap, fill_value=n)[0].astype(I32)
+    if blk is None:
+        blk = 8
+        while blk * blk * cap < n and blk < 256:
+            blk *= 2
+    nb = -(-n // blk)
+    pad = nb * blk - n
+    m = jnp.pad(mask, (0, pad)) if pad else mask
+    m = m.reshape(nb, blk)
+    bc = m.sum(axis=1, dtype=I32)
+    capb = min(nb, cap)
+    bidx = jnp.nonzero(bc > 0, size=capb, fill_value=nb)[0].astype(I32)
+    rows = m.at[bidx].get(mode="fill", fill_value=False)
+    bcnt = bc.at[bidx].get(mode="fill", fill_value=0)
+    off = jnp.cumsum(bcnt) - bcnt  # exclusive: output offset of each block
+    local = jnp.cumsum(rows.astype(I32), axis=1) - 1
+    pos = off[:, None] + local
+    gidx = bidx[:, None] * blk + jnp.arange(blk, dtype=I32)[None, :]
+    take = rows & (pos < cap)
+    out = jnp.full((cap,), n, I32)
+    return out.at[jnp.where(take, pos, cap)].set(
+        jnp.where(take, gidx, n), mode="drop")
 
 
 def tick_keys(base_key: jax.Array, tick, shard: jax.Array | int | None = None):
@@ -130,8 +181,13 @@ def tick_core(cfg: Config, st: SimState, keys: dict):
     received = st.received | newly
     d_received = newly.sum(dtype=I32)
 
-    dslot = _delay_and_slot(cfg, keys["delay"], st.tick, (n,))
-    dslot = jnp.broadcast_to(dslot, (n,)).astype(I32)
+    # Dense per-row delay slots are only materialized when something consumes
+    # them for all n rows (SIR's re-broadcast scheduling, or the dense
+    # delivery path); the compact SI path draws slots per gathered row.
+    if sir or not cfg.compact_resolved:
+        dslot = row_slot(cfg, keys["delay"], st.tick, ids)
+    else:
+        dslot = None
 
     if sir:
         due = st.rebroadcast[slot] & ~crashed & ~st.removed
@@ -157,9 +213,11 @@ def edges_from_senders(cfg: Config, friends, friend_cnt, senders, dslot,
     """Flatten this tick's outgoing wave into (dst_global, dslot, valid) flat
     arrays -- the message list the delivery layer (local scatter or
     cross-shard all_to_all route) consumes.  Per-link drop draw happens here
-    (simulator.go:144); the shared per-broadcast delay came in via dslot."""
+    (simulator.go:144), row-keyed so the compact path samples identically;
+    the shared per-broadcast delay came in via dslot."""
     n, k = friends.shape
-    drop = _rng.bernoulli(drop_key, p_eff(cfg, cfg.droprate), (n, k))
+    rows = jnp.arange(n, dtype=I32)
+    drop = _rng.row_bernoulli(drop_key, p_eff(cfg, cfg.droprate), rows, k)
     edge = (jnp.arange(k, dtype=I32)[None, :] < friend_cnt[:, None]) \
         & senders[:, None] & ~drop & (friends >= 0)
     dst = jnp.where(edge, friends, -1).reshape(-1)
@@ -169,28 +227,35 @@ def edges_from_senders(cfg: Config, friends, friend_cnt, senders, dslot,
 
 def compact_chunk_cap(cfg: Config, n_local: int) -> int:
     """Static sender-compaction chunk size.  In ticks mode the per-tick wave
-    is spread over the delay window, so n/4 covers the peak with the chunked
-    loop as a correctness backstop; rounds mode processes everything at once."""
+    is spread over the delay window; n/128 keeps the per-chunk gather small
+    (first_true_indices touches cap x blk elements) with the chunked loop
+    absorbing peak ticks; rounds mode processes everything at once."""
     if cfg.compact_chunk > 0:
         return min(n_local, cfg.compact_chunk)
     if cfg.effective_time_mode == "rounds":
         return n_local
-    return min(n_local, max(1024, n_local // 4))
+    return min(n_local, max(4096, n_local // 128))
 
 
-def compact_gather(friends, friend_cnt, dslot, drop, remaining, cap):
+def compact_gather(cfg: Config, friends, friend_cnt, dslot, delay_key,
+                   drop_key, tick, remaining, cap):
     """Pull the next <=cap sender rows out of `remaining` and return their
     edge list (dst, slot, valid) plus the updated remaining mask.  Fill rows
-    (index n) gather as invalid.  Bit-identical to the dense path because the
-    caller drew `drop` densely."""
+    (index n) gather as invalid.  Drop masks and delay slots are row-keyed
+    (utils/rng.row_keys), drawn here for just the gathered rows -- bit-
+    identical to the dense path's draws for the same rows (tested)."""
     n, k = friends.shape
-    idx = jnp.nonzero(remaining, size=cap, fill_value=n)[0].astype(I32)
+    idx = first_true_indices(remaining, cap)
     hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
     remaining = remaining & ~hit
     sf = friends.at[idx].get(mode="fill", fill_value=-1)
     scnt = friend_cnt.at[idx].get(mode="fill", fill_value=0)
-    sdrop = drop.at[idx].get(mode="fill", fill_value=True)
-    sslot = dslot.at[idx].get(mode="fill", fill_value=0)
+    # Fill rows draw junk (row id n) but their edges are already invalid.
+    sdrop = _rng.row_bernoulli(drop_key, p_eff(cfg, cfg.droprate), idx, k)
+    if dslot is not None:
+        sslot = dslot.at[idx].get(mode="fill", fill_value=0)
+    else:
+        sslot = row_slot(cfg, delay_key, tick, idx)
     edge = (jnp.arange(k, dtype=I32)[None, :] < scnt[:, None]) \
         & ~sdrop & (sf >= 0)
     dst = jnp.where(edge, sf, -1).reshape(-1)
@@ -198,14 +263,12 @@ def compact_gather(friends, friend_cnt, dslot, drop, remaining, cap):
     return dst, slots, edge.reshape(-1), remaining
 
 
-def deposit_compact(cfg: Config, pending, friends, friend_cnt, senders, dslot,
-                    drop_key):
+def deposit_compact(cfg: Config, pending, friends, friend_cnt,
+                    senders, dslot, delay_key, drop_key, tick):
     """Compacted equivalent of edges_from_senders + deposit_local: only
-    actual sender rows reach the gather/scatter.  The Bernoulli drop mask is
-    still drawn densely with the same key, so the simulation trajectory is
-    bit-identical to the dense path (tested)."""
+    actual sender rows reach the RNG, gather and scatter.  Row-keyed draws
+    keep the trajectory bit-identical to the dense path (tested)."""
     n, k = friends.shape
-    drop = _rng.bernoulli(drop_key, p_eff(cfg, cfg.droprate), (n, k))
     cap = compact_chunk_cap(cfg, n)
     count = senders.sum(dtype=I32)
     chunks = (count + cap - 1) // cap
@@ -213,7 +276,8 @@ def deposit_compact(cfg: Config, pending, friends, friend_cnt, senders, dslot,
     def body(_, carry):
         pending, remaining = carry
         dst, slots, valid, remaining = compact_gather(
-            friends, friend_cnt, dslot, drop, remaining, cap)
+            cfg, friends, friend_cnt, dslot, delay_key, drop_key, tick,
+            remaining, cap)
         return deposit_local(pending, dst, slots, valid), remaining
 
     pending, _ = jax.lax.fori_loop(0, chunks, body, (pending, senders))
@@ -231,13 +295,20 @@ def deposit_local(pending, dst_local, slots, valid):
 def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     """Single-device per-tick transition for SI / SIR push gossip."""
 
+    # NOTE: do NOT wrap this in a lax.cond "skip empty ticks" fast path.
+    # On the axon TPU platform, lax.cond whose taken branch contains the
+    # dynamic-trip-count chunk fori_loop, nested inside the window fori_loop,
+    # miscompiles: every gathered chunk row scatters regardless of validity
+    # (observed at n=2e5: pending gained cap*k counts per tick and the
+    # epidemic stalled).  Root-caused 2026-07-30; the skip also measured no
+    # wall-clock win (empty slots are rare once delays spread the wave).
     def tick_fn(st: SimState, base_key: jax.Array) -> SimState:
         keys = tick_keys(base_key, st.tick)
         stp, senders, dslot, (dm, dr, dc) = tick_core(cfg, st, keys)
         if cfg.compact_resolved:
-            pending = deposit_compact(cfg, stp.pending, stp.friends,
-                                      stp.friend_cnt, senders, dslot,
-                                      keys["drop"])
+            pending = deposit_compact(
+                cfg, stp.pending, stp.friends, stp.friend_cnt, senders,
+                dslot, keys["delay"], keys["drop"], st.tick)
         else:
             dst, slots, valid = edges_from_senders(
                 cfg, stp.friends, stp.friend_cnt, senders, dslot,
@@ -270,8 +341,7 @@ def make_seed_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
             total_received = total_received + 1
         if cfg.protocol == "pushpull":
             return st._replace(received=received, total_received=total_received)
-        dslot = _delay_and_slot(cfg, kd, st.tick, (n,))
-        dslot = jnp.broadcast_to(dslot, (n,)).astype(I32)
+        dslot = row_slot(cfg, kd, st.tick, jnp.arange(n, dtype=I32))
         dst, slots, valid = edges_from_senders(
             cfg, st.friends, st.friend_cnt, is_sender, dslot, kp)
         pending = deposit_local(st.pending, dst, slots, valid)
@@ -352,28 +422,43 @@ def make_step_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
 
 
 def make_window_fn(cfg: Config, window: int):
-    """`window` consecutive steps as one device call (one progress window)."""
+    """`window` consecutive steps as one device call (one progress window).
+    The state is donated: the pending ring mutates in place instead of
+    costing a fresh HBM allocation + copy per window (essential at 100M,
+    where two ring copies would not fit)."""
     step = make_step_fn(cfg)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def window_fn(st: SimState, base_key: jax.Array) -> SimState:
         return jax.lax.fori_loop(0, window, lambda _, s: step(s, base_key), st)
 
     return window_fn
 
 
+def run_call_budget(cfg: Config) -> int:
+    """Ticks per run_to_coverage device call.  One giant while_loop call can
+    run for minutes at large n, long enough to trip device-runtime watchdogs
+    (observed as UNAVAILABLE faults at n=1e7 on v5e through the remote
+    tunnel), so the host loop re-enters a bounded call until done -- same
+    compiled executable, same trajectory (keys depend only on tick)."""
+    return max(64, min(cfg.max_rounds, int(3.3e9 // max(cfg.n, 1))))
+
+
 def make_run_to_coverage_fn(cfg: Config):
-    """Device-side while_loop to the coverage target: zero host syncs in the
-    hot loop (the reference's 10 ms polling becomes one device-side predicate,
-    simulator.go:243-251).  Used by bench.py and the `-quiet` fast path."""
+    """Device-side while_loop toward the coverage target: zero host syncs in
+    the hot loop (the reference's 10 ms polling becomes one device-side
+    predicate, simulator.go:243-251).  Runs until target/max_rounds/`until`
+    ticks; callers loop over bounded calls (run_call_budget)."""
     step = make_step_fn(cfg)
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
 
-    @functools.partial(jax.jit, static_argnums=(2,))
-    def run_fn(st: SimState, base_key: jax.Array, target_count: int) -> SimState:
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_fn(st: SimState, base_key: jax.Array, target_count: jax.Array,
+               until: jax.Array) -> SimState:
         def cond(s: SimState):
-            return (s.total_received < target_count) & (s.tick < max_steps)
+            return ((s.total_received < target_count)
+                    & (s.tick < max_steps) & (s.tick < until))
 
         def body(s: SimState):
             # One window per iteration keeps the predicate check off the

@@ -43,10 +43,21 @@ def kout(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None):
         from gossip_simulator_tpu.ops.pallas_graph import (
             BLOCK_ROWS, kout_pallas)
 
-        if k <= 128 and row0 % BLOCK_ROWS == 0:
-            interpret = jax.default_backend() != "tpu"
-            friends = kout_pallas(n, k, row0, rows, cfg.seed, interpret)
+        # TPU only: in interpret mode (CPU/GPU) pltpu.prng_random_bits is an
+        # all-zero stub, which would silently yield a degenerate star graph
+        # -- fall through to the fold_in generator there instead.
+        if (k <= 128 and row0 % BLOCK_ROWS == 0
+                and jax.default_backend() == "tpu"):
+            friends = kout_pallas(n, k, row0, rows, cfg.seed, interpret=False)
             return friends, jnp.full((rows,), k, dtype=jnp.int32)
+    if cfg.pallas:
+        import warnings
+
+        warnings.warn(
+            "-pallas requested but the Pallas kout generator is unavailable "
+            "here (needs a real TPU backend, fanout <= 128, block-aligned "
+            "static row offset -- the sharded backend's traced row0 does not "
+            "qualify); using the fold_in generator instead", stacklevel=2)
     ids = (row0 + jnp.arange(rows, dtype=jnp.int32))[:, None]
     keys = _row_keys(key, row0, rows)
     picks = jax.vmap(

@@ -18,7 +18,11 @@ Properties:
   statistics).  Self-collisions get the reference's (id+1) % n patch
   (simulator.go:98-100).
 
-Off-TPU (tests) runs under pltpu.InterpretParams -- same semantics.
+Off-TPU, interpret=True runs under pltpu.InterpretParams for STRUCTURAL
+checks only: the interpreter's prng_random_bits is an all-zero stub, so the
+"graph" degenerates to everyone-befriends-node-0.  models/graphs.py therefore
+routes to this kernel only on a real TPU backend; never validate
+distributional properties in interpret mode.
 """
 
 from __future__ import annotations

@@ -90,13 +90,10 @@ def make_sharded_tick(cfg: Config, mesh):
         stp, senders, dslot, (dm, dr, dc) = epidemic.tick_core(cfg, st, keys)
         width = stp.friends.shape[1]
         if cfg.compact_resolved:
-            # Compacted wave: only sender rows reach the sort/all_to_all.
+            # Compacted wave: only sender rows reach the RNG/sort/all_to_all.
             # Chunk count is agreed across shards (pmax) so every shard
             # executes the same number of collectives.
             ccap = epidemic.compact_chunk_cap(cfg, n_local)
-            drop = _rng.bernoulli(keys["drop"],
-                                  epidemic.p_eff(cfg, cfg.droprate),
-                                  (n_local, width))
             count = jax.lax.pmax(senders.sum(dtype=I32), AXIS)
             chunks = (count + ccap - 1) // ccap
             # Per-chunk route cap: never below the dense path's (so any wave
@@ -107,7 +104,8 @@ def make_sharded_tick(cfg: Config, mesh):
             def body(_, carry):
                 pending, remaining, ovf = carry
                 dstg, slots, valid, remaining = epidemic.compact_gather(
-                    stp.friends, stp.friend_cnt, dslot, drop, remaining, ccap)
+                    cfg, stp.friends, stp.friend_cnt, dslot, keys["delay"],
+                    keys["drop"], st.tick, remaining, ccap)
                 pending, o = _deposit_routed(cfg, n_local, s, pending,
                                              dstg, slots, valid, rcap)
                 return pending, remaining, ovf + o
@@ -123,6 +121,8 @@ def make_sharded_tick(cfg: Config, mesh):
                 cfg, n_local, s, stp.pending, dst, slots, valid,
                 exchange.epidemic_cap(n_local, width, s))
         dm, dr, dc, ovf = jax.lax.psum((dm, dr, dc, ovf), AXIS)
+        # NOTE: no lax.cond empty-slot skip here -- see the miscompile note
+        # in epidemic.make_tick_fn (axon platform, cond + dynamic fori).
         return stp._replace(
             pending=pending,
             total_message=stp.total_message + dm,
@@ -247,8 +247,8 @@ def make_sharded_seed(cfg: Config, mesh):
         if cfg.protocol == "pushpull":
             return st._replace(received=received,
                                total_received=total_received)
-        dslot = epidemic._delay_and_slot(cfg, kd, st.tick, ())
-        dslot = jnp.broadcast_to(dslot, (n_local,)).astype(I32)
+        dslot = epidemic.row_slot(cfg, kd, st.tick,
+                                  jnp.arange(n_local, dtype=I32))
         dst, slots, valid = epidemic.edges_from_senders(
             cfg, st.friends, st.friend_cnt, is_sender, dslot, kp)
         pending, ovf = _deposit_routed(
@@ -356,7 +356,7 @@ def make_window_fn(cfg: Config, mesh, window: int):
         return jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), st)
 
     return jax.jit(_shard_map(mesh, window_shard, in_specs=(specs, P()),
-                              out_specs=specs))
+                              out_specs=specs), donate_argnums=(0,))
 
 
 def make_seed_fn(cfg: Config, mesh):
@@ -372,16 +372,20 @@ def make_overlay_round_fn(cfg: Config, mesh):
 
 
 def make_run_to_coverage_fn(cfg: Config, mesh):
+    """Bounded device-side while_loop (see epidemic.run_call_budget): the
+    host re-enters until target/max_rounds/exhaustion."""
     step = make_sharded_step(cfg, mesh)
     specs = sim_state_specs()
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
 
-    @functools.partial(jax.jit, static_argnums=(2,))
-    def run(st: SimState, base_key: jax.Array, target_count: int) -> SimState:
-        def run_shard(st, base_key):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(st: SimState, base_key: jax.Array, target_count: jax.Array,
+            until: jax.Array) -> SimState:
+        def run_shard(st, base_key, target_count, until):
             def cond(s):
-                return (s.total_received < target_count) & (s.tick < max_steps)
+                return ((s.total_received < target_count)
+                        & (s.tick < max_steps) & (s.tick < until))
 
             def body(s):
                 return jax.lax.fori_loop(
@@ -389,7 +393,7 @@ def make_run_to_coverage_fn(cfg: Config, mesh):
 
             return jax.lax.while_loop(cond, body, st)
 
-        return _shard_map(mesh, run_shard, in_specs=(specs, P()),
-                          out_specs=specs)(st, base_key)
+        return _shard_map(mesh, run_shard, in_specs=(specs, P(), P(), P()),
+                          out_specs=specs)(st, base_key, target_count, until)
 
     return run

@@ -52,6 +52,35 @@ def bernoulli(key: jax.Array, p, shape, compat_reference: bool = False) -> jax.A
     return jax.random.bernoulli(key, p, shape)
 
 
+def row_keys(key: jax.Array, rows: jax.Array) -> jax.Array:
+    """One derived key per row id (vmapped fold_in).  Row-keyed draws make a
+    gathered subset of rows compute exactly the values the dense computation
+    would -- the compaction paths sample only the rows they touch while
+    staying bit-identical to the dense fallback."""
+    return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, rows)
+
+
+def row_bernoulli(key: jax.Array, p, rows: jax.Array, k: int) -> jax.Array:
+    """Bernoulli(p) mask of shape (len(rows), k), row-keyed (see row_keys)."""
+    m = rows.shape[0]
+    if p <= 0.0:
+        return jnp.zeros((m, k), dtype=bool)
+    if p >= 1.0:
+        return jnp.ones((m, k), dtype=bool)
+    ks = row_keys(key, rows)
+    return jax.vmap(lambda kk: jax.random.bernoulli(kk, p, (k,)))(ks)
+
+
+def row_uniform_delay(key: jax.Array, low: int, high: int,
+                      rows: jax.Array) -> jax.Array:
+    """Row-keyed integer delay in [low, high) ticks, clamped to >= 1
+    (see uniform_delay)."""
+    ks = row_keys(key, rows)
+    d = jax.vmap(
+        lambda kk: jax.random.randint(kk, (), low, high, dtype=jnp.int32))(ks)
+    return jnp.maximum(d, 1)
+
+
 def uniform_delay(key: jax.Array, low: int, high: int, shape) -> jax.Array:
     """Integer ticks uniform in [low, high), matching RandomNetworkDelay
     (simulator.go:166-168); clamped to >= 1 so a message never lands in the

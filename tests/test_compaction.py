@@ -1,6 +1,10 @@
 """Wavefront compaction (epidemic.deposit_compact / sharded chunked route)
-must be BIT-IDENTICAL to the dense path: the drop mask is drawn densely with
-the same key, compaction only changes which rows reach the gather/scatter."""
+must be BIT-IDENTICAL to the dense path: drop masks and delay slots are
+row-keyed (utils/rng.row_keys), so the compacted gather draws exactly the
+values the dense path would for the same rows."""
+
+import numpy as np
+import pytest
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.driver import run_simulation
@@ -45,6 +49,41 @@ def test_multi_chunk_identical_jax():
     # remaining-mask carry across chunk boundaries.
     on, off = _pair("jax", compact_chunk=64)
     assert on.stats == off.stats
+
+
+CASES = ["sparse", "clustered", "dense", "empty", "all", "tail", "head"]
+
+
+@pytest.mark.parametrize("case", CASES)
+@pytest.mark.parametrize("n,cap", [(5000, 64), (5000, 4999), (20000, 777),
+                                   (8192, 256)])
+def test_first_true_indices_two_level(case, n, cap):
+    """The two-level block path (taken at n > 4096) must match
+    jnp.nonzero(size=cap, fill_value=n) exactly -- first <=cap True indices
+    ascending, padded with n.  Covers the production path bench runs at
+    n=1e7, which the simulation tests (small n) never reach."""
+    import jax.numpy as jnp
+
+    from gossip_simulator_tpu.models.epidemic import first_true_indices
+
+    rng = np.random.default_rng((CASES.index(case) + 1) * 1_000_003 + n + cap)
+    mask = np.zeros(n, bool)
+    if case == "sparse":
+        mask[rng.choice(n, size=37, replace=False)] = True
+    elif case == "clustered":
+        mask[1234:1234 + 3 * cap] = True
+    elif case == "dense":
+        mask = rng.random(n) < 0.3
+    elif case == "all":
+        mask[:] = True
+    elif case == "tail":
+        mask[-5:] = True
+    elif case == "head":
+        mask[:5] = True
+    got = np.asarray(first_true_indices(jnp.asarray(mask), cap))
+    want = np.asarray(
+        jnp.nonzero(jnp.asarray(mask), size=cap, fill_value=n)[0])
+    np.testing.assert_array_equal(got, want)
 
 
 def test_multi_chunk_identical_sharded():
