@@ -97,9 +97,10 @@ def run_bounded_to_target(stepper) -> Stats:
         stepper.state = stepper._run_fn(stepper.state, stepper.key,
                                         np.int32(target), np.int32(until))
         st = stepper.state
+        from gossip_simulator_tpu.models.event import in_flight as _inflight
+
         tick, recv, in_flight = (int(x) for x in jax.device_get(
-            (st.tick, st.total_received,
-             st.pending.sum() + st.rebroadcast.sum())))
+            (st.tick, st.total_received, _inflight(st))))
         if recv >= target or tick >= cfg.max_rounds:
             break
         if in_flight == 0 and cfg.protocol != "pushpull":

@@ -15,7 +15,7 @@ import jax
 import numpy as np
 
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
-from gossip_simulator_tpu.models import epidemic, graphs, overlay
+from gossip_simulator_tpu.models import epidemic, event, graphs, overlay
 from gossip_simulator_tpu.utils import rng as _rng
 from gossip_simulator_tpu.utils.metrics import Stats
 
@@ -26,6 +26,7 @@ class JaxStepper(Stepper):
     def init(self) -> None:
         cfg = self.cfg
         self.key = _rng.base_key(cfg.seed)
+        self._engine = event if cfg.engine_resolved == "event" else epidemic
         self._mean_delay = (
             (cfg.delaylow + cfg.delayhigh) / 2.0
             if cfg.effective_time_mode == "ticks" else 1.0)
@@ -38,12 +39,12 @@ class JaxStepper(Stepper):
             self.state = None
         else:
             friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
-            self.state = epidemic.init_state(cfg, friends, cnt)
+            self.state = self._engine.init_state(cfg, friends, cnt)
             self._overlay_done = True
-        self._seed_fn = jax.jit(epidemic.make_seed_fn(cfg))
+        self._seed_fn = jax.jit(self._engine.make_seed_fn(cfg))
         self._window = 1 if cfg.effective_time_mode == "rounds" else WINDOW_MS
-        self._window_fn = epidemic.make_window_fn(cfg, self._window)
-        self._run_fn = epidemic.make_run_to_coverage_fn(cfg)
+        self._window_fn = self._engine.make_window_fn(cfg, self._window)
+        self._run_fn = self._engine.make_run_to_coverage_fn(cfg)
         self._mailbox_dropped = 0
 
     # --- phase 1 ---------------------------------------------------------------
@@ -59,7 +60,7 @@ class JaxStepper(Stepper):
             self._overlay_done = True
             self._mailbox_dropped = int(jax.device_get(
                 self.ostate.mailbox_dropped))
-            self.state = epidemic.init_state(
+            self.state = self._engine.init_state(
                 self.cfg, self.ostate.friends, self.ostate.friend_cnt)
             self.ostate = None  # free phase-1 buffers
         return int(mk), int(bk), bool(q)
@@ -71,10 +72,7 @@ class JaxStepper(Stepper):
 
     def gossip_window(self) -> Stats:
         self.state = self._window_fn(self.state, self.key)
-        st = self.state
-        stats = self.stats()
-        in_flight = int(jax.device_get(
-            st.pending.sum() + st.rebroadcast.sum()))
+        stats, in_flight = self._stats_and_inflight()
         self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
         return stats
 
@@ -86,7 +84,7 @@ class JaxStepper(Stepper):
         if cfg.graph == "overlay":
             raise ValueError("reset_state requires a static graph")
         friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
-        self.state = epidemic.init_state(cfg, friends, cnt)
+        self.state = self._engine.init_state(cfg, friends, cnt)
         self.exhausted = False
 
     def run_to_target(self) -> Stats:
@@ -96,17 +94,23 @@ class JaxStepper(Stepper):
 
         return run_bounded_to_target(self)
 
-    def stats(self) -> Stats:
+    def _stats_and_inflight(self) -> tuple[Stats, int]:
+        """All progress-window scalars in ONE host round-trip (each
+        device_get is a synchronous hop through the TPU tunnel)."""
         st = self.state
-        tm, tr, tc = jax.device_get(
-            (st.total_message, st.total_received, st.total_crashed))
+        extra = st.mail_dropped if hasattr(st, "mail_dropped") else 0
+        tm, tr, tc, tick, dropped, in_flight = jax.device_get(
+            (st.total_message, st.total_received, st.total_crashed,
+             st.tick, extra, event.in_flight(st)))
         return Stats(
-            n=self.cfg.n,
-            round=int(jax.device_get(st.tick)),
+            n=self.cfg.n, round=int(tick),
             total_received=int(tr), total_message=int(tm),
             total_crashed=int(tc),
-            mailbox_dropped=self._mailbox_dropped,
-        )
+            mailbox_dropped=self._mailbox_dropped + int(dropped),
+        ), int(in_flight)
+
+    def stats(self) -> Stats:
+        return self._stats_and_inflight()[0]
 
     def sim_time_ms(self) -> float:
         if self.state is None or not self._overlay_done:
@@ -120,8 +124,16 @@ class JaxStepper(Stepper):
         return {k: np.asarray(v) for k, v in self.state._asdict().items()}
 
     def load_state_pytree(self, tree) -> None:
+        from gossip_simulator_tpu.models.event import EventState
         from gossip_simulator_tpu.models.state import SimState
 
-        self.state = SimState(**{k: jax.numpy.asarray(v)
-                                 for k, v in tree.items()})
+        ckpt_engine = "event" if "mail_ids" in tree else "ring"
+        if ckpt_engine != self.cfg.engine_resolved:
+            raise ValueError(
+                f"checkpoint was written by the {ckpt_engine} engine but "
+                f"this run resolves to {self.cfg.engine_resolved}; pass "
+                f"-engine {ckpt_engine} to restore it")
+        cls = EventState if ckpt_engine == "event" else SimState
+        self.state = cls(**{k: jax.numpy.asarray(v)
+                            for k, v in tree.items()})
         self._overlay_done = True

@@ -29,6 +29,7 @@ BACKENDS = ("native", "cpp", "jax", "sharded")
 PROTOCOLS = ("si", "pushpull", "sir")
 GRAPHS = ("overlay", "kout", "erdos", "ring")
 TIME_MODES = ("ticks", "rounds")
+ENGINES = ("auto", "ring", "event")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +81,20 @@ class Config:
     # epidemic.compact_chunk_cap).  Exposed mainly so tests can force the
     # multi-chunk path at small n.
     compact_chunk: int = -1
+    # Epidemic engine (single-device jax backend): "ring" keeps per-(slot,
+    # node) arrival counts (O(n) per tick); "event" keeps per-slot message
+    # id-lists (O(arrivals) per tick -- models/event.py).  "auto" = event for
+    # SI in ticks mode on the jax backend (unless compact is explicitly
+    # set, a ring-engine request), ring otherwise.
+    engine: str = "auto"
+    # Event engine per-WINDOW-slot message capacity (-1 = auto: see
+    # event.slot_cap -- 1.5*n*max_degree*B/delay_span, bounded by the SI
+    # message total and int32 flat addressing; overflow is counted in
+    # Stats.mailbox_dropped, never silent).
+    event_slot_cap: int = -1
+    # Event engine drain chunk size (-1 = auto: 524288; see
+    # event.drain_chunk).
+    event_chunk: int = -1
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
@@ -127,6 +142,21 @@ class Config:
         return self.compact == "on"
 
     @property
+    def engine_resolved(self) -> str:
+        """Event engine requires SI + ticks semantics and currently serves
+        the single-device jax backend; everything else uses the ring engine.
+        An explicit `-compact on` is a ring-engine request (the event engine
+        has no dense path to compact), so auto honors it."""
+        if self.engine == "event":
+            return "event"
+        if (self.engine == "auto" and self.backend == "jax"
+                and self.protocol == "si"
+                and self.effective_time_mode == "ticks"
+                and self.compact == "auto"):
+            return "event"
+        return "ring"
+
+    @property
     def mailbox_cap_resolved(self) -> int:
         if self.mailbox_cap > 0:
             return self.mailbox_cap
@@ -168,6 +198,15 @@ class Config:
         if self.compact not in ("auto", "on", "off"):
             raise ValueError(
                 f"compact must be auto|on|off, got {self.compact!r}")
+        if self.engine not in ENGINES:
+            raise ValueError(f"engine must be one of {ENGINES}, got {self.engine!r}")
+        if self.engine == "event":
+            if self.protocol != "si" or self.effective_time_mode != "ticks":
+                raise ValueError(
+                    "engine=event supports protocol=si in ticks mode only")
+            if self.backend not in ("jax",):
+                raise ValueError(
+                    "engine=event currently requires backend=jax")
         if self.time_mode not in TIME_MODES:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"
@@ -244,6 +283,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-pallas", "--pallas", action="store_true")
     p.add_argument("-compact", "--compact", choices=("auto", "on", "off"),
                    default="auto")
+    p.add_argument("-engine", "--engine", choices=ENGINES, default=d.engine)
+    p.add_argument("-event-slot-cap", "--event-slot-cap",
+                   dest="event_slot_cap", type=int, default=d.event_slot_cap)
+    p.add_argument("-event-chunk", "--event-chunk", dest="event_chunk",
+                   type=int, default=d.event_chunk)
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

@@ -1,0 +1,407 @@
+"""Event-list epidemic engine: cost O(arrivals), not O(n x ticks).
+
+The ring engine (models/epidemic.py) stores arrival *counts* per (slot, node)
+-- every tick drains an n-length row, so a 280-tick run at n=1e7 pays 280
+O(n) passes even though only ~24M messages ever exist.  This engine stores
+the messages themselves -- the TPU-native analog of the reference's per-node
+mailbox channels (simulator.go:51-54), batched by delivery time.
+
+Batching granularity is a WINDOW of B = min(10, delaylow) ticks: every
+network delay is >= delaylow >= B, so a message delivered inside a window
+cannot cause another delivery in the same window -- the whole window drains
+as one chunked batch with zero intra-batch causality.  (B collapses to 1 for
+sub-window delays, recovering per-tick processing.)  Per-op dispatch
+overhead dominates on this platform (each fusion-breaking op costs ~2-5ms
+regardless of 16k-256k size), so one batch per window instead of ten is the
+difference between the event engine winning and losing to the ring engine.
+
+Mail ring: `mail_ids[dw, cap]` holds PACKED entries `dst * B + tick_off`
+(delivery tick within the window; sentinel `n * B` marks dropped-edge
+padding), `mail_cnt[dw]` the live counts.  Draining sorts each chunk by
+(id, crash-fired-first, tick_off): a node's entries become one contiguous
+run whose FIRST element answers everything -- did any crash draw fire, and
+(if not) the earliest delivery tick, which seeds the re-broadcast delay
+draw.  Infection dedupe across chunks rides the `received` array.
+
+RNG parity with the ring engine: drop masks and delay slots are drawn from
+the identical (seed, delivery-tick, op, sender-row) streams, so with
+crashrate=0 the wave trajectory -- totals and window-resolution timing --
+is bit-identical to the ring engine (tested).  Documented divergences, all
+crash-path only:
+* Crash draws are per *message* (keyed by mailbox position), like the
+  reference's per-reception draw (simulator.go:112-116), instead of the
+  ring engine's aggregated 1-(1-p)^c per node-tick.
+* Within one window, a crash does not black-hole the node's other
+  deliveries of that window (the reference's channel would, for messages
+  queued behind the crash; the margin is ~crashrate x multi-delivery rate).
+* A node that would be infected at tick t1 and crashed at t2 > t1 in the
+  SAME window is treated as crashed-before-infected (no broadcast).
+* When a window drains in multiple chunks, a node whose entries span a
+  chunk boundary re-broadcasts from its first-ENCOUNTERED delivery tick
+  rather than its globally earliest one (dedupe itself stays exact via the
+  received array).
+
+Control-flow note: built strictly from constructs proven on the axon TPU
+platform -- outer fori windows, inner dynamic-trip fori chunks, gathers,
+flat 1-D mode="drop" scatters (2-D index scatters are ~15x slower here),
+lax.sort.  Deliberately NO lax.cond (see the miscompile NOTE in
+epidemic.make_tick_fn).
+
+Capacity: slot_cap(cfg) packed entries per window slot; appends beyond it
+are dropped and counted in `mail_dropped` (Stats.mailbox_dropped), never
+silent.  SI in-flight is bounded by n * max_degree spread over the delay
+span; the default covers peak skew ~1.5x over.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models import epidemic
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+
+
+class EventState(NamedTuple):
+    """SI epidemic state with packed message lists instead of count rings."""
+
+    received: jnp.ndarray  # bool[n]
+    crashed: jnp.ndarray  # bool[n]
+    friends: jnp.ndarray  # int32[n, k]
+    friend_cnt: jnp.ndarray  # int32[n]
+    # Flat (dw * cap + drain_chunk,) packed ring: slot s occupies
+    # [s*cap, (s+1)*cap).  Stored flat (not (dw, cap)) so the append scatter
+    # updates it in place -- a reshape round-trip defeats XLA's donation
+    # aliasing and copies the multi-GB ring once per chunk (measured
+    # 6s/window at n=5e7).  The tail padding serves two purposes: index
+    # dw*cap is an explicit trash cell for overflowed writes (on the axon
+    # TPU stack, mode="drop" OOB semantics for flattened scatter indices
+    # were observed being miscompiled -- see epidemic.deposit_local), and a
+    # full drain_chunk of slack keeps the last drain slice of a full slot
+    # from clamping (clamped dynamic_slice would misalign entry validity).
+    mail_ids: jnp.ndarray  # int32[dw * cap + drain_chunk]
+    mail_cnt: jnp.ndarray  # int32[dw]
+    tick: jnp.ndarray  # int32[]
+    total_message: jnp.ndarray  # int32[]
+    total_received: jnp.ndarray  # int32[]
+    total_crashed: jnp.ndarray  # int32[]
+    mail_dropped: jnp.ndarray  # int32[]  slot-capacity overflow (counted)
+
+
+def batch_ticks(cfg: Config, n_local: int | None = None) -> int:
+    """Window size B: delays >= delaylow >= B guarantee no intra-window
+    causality.  Also bounded so the packed id*B+tick_off fits int32."""
+    n = n_local if n_local is not None else cfg.n
+    b = max(1, min(10, cfg.delaylow))
+    while b > 1 and (n + 1) * b >= 2**31:
+        b //= 2
+    return b
+
+
+def ring_windows(cfg: Config, n_local: int | None = None) -> int:
+    """Window-slot ring depth: max arrival offset in windows, plus current."""
+    b = batch_ticks(cfg, n_local)
+    return (b - 1 + cfg.delayhigh - 1) // b + 1
+
+
+def slot_cap(cfg: Config, n_local: int | None = None) -> int:
+    """Packed entries per window slot.  SI total in-flight <= n * max_degree
+    spread over delay_span ticks; a window aggregates B ticks of it, 1.5x
+    covers skew (overflow is counted, never silent).  Clamped so the flat
+    scatter index dw * cap stays in int32."""
+    n = n_local if n_local is not None else cfg.n
+    b = batch_ticks(cfg, n_local)
+    dw = ring_windows(cfg, n_local)
+    cap = cfg.event_slot_cap if cfg.event_slot_cap > 0 else max(
+        4096, int(math.ceil(1.5 * n * cfg.max_degree * b
+                            / max(cfg.delay_span, 1))))
+    # One slot can never hold more than every SI message plus padding.
+    cap = min(cap, n * cfg.max_degree + cfg.max_degree)
+    return min(cap, (2**31 - 1) // max(dw, 1))
+
+
+def drain_chunk(cfg: Config) -> int:
+    """Drain chunk size: large, because per-op dispatch overhead (not element
+    count) dominates chunk cost on this platform."""
+    want = cfg.event_chunk if cfg.event_chunk > 0 else 524_288
+    return min(slot_cap(cfg), max(256, want))
+
+
+def init_state(cfg: Config, friends: jnp.ndarray,
+               friend_cnt: jnp.ndarray) -> EventState:
+    n = friends.shape[0]
+    z = lambda: jnp.zeros((), I32)
+    return EventState(
+        received=jnp.zeros((n,), bool),
+        crashed=jnp.zeros((n,), bool),
+        friends=friends,
+        friend_cnt=friend_cnt,
+        mail_ids=jnp.zeros(
+            (ring_windows(cfg) * slot_cap(cfg) + drain_chunk(cfg),), I32),
+        mail_cnt=jnp.zeros((ring_windows(cfg),), I32),
+        tick=z(), total_message=z(), total_received=z(), total_crashed=z(),
+        mail_dropped=z(),
+    )
+
+
+def _sender_keys(base_key, op: int, ticks, rows):
+    """Per-sender key fold_in(fold_in(fold_in(base, tick), op), row) -- the
+    exact stream epidemic.row_slot / row_bernoulli draw from for a sender
+    broadcasting at `tick`, vectorized over per-sender delivery ticks."""
+    def one(t, r):
+        return jax.random.fold_in(_rng.tick_key(base_key, t, op), r)
+
+    return jax.vmap(one)(ticks, rows)
+
+
+def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
+                    svalid, sticks, friends, friend_cnt, base_key):
+    """Emit each sender's broadcast (k sends, ONE shared delay drawn at its
+    delivery tick -- simulator.go:141-142) into the packed mail ring.
+
+    A sender's k messages share one arrival tick, hence one window slot:
+    each sender reserves k contiguous positions there (rank via a
+    (senders, dw) one-hot cumsum), dropped/invalid edges are written as the
+    sentinel id so reservations stay contiguous, and the write is one flat
+    1-D mode="drop" scatter."""
+    n, k = friends.shape
+    dw = ring_windows(cfg)
+    cap = (mail_ids.shape[0] - drain_chunk(cfg)) // dw
+    b = batch_ticks(cfg)
+    rows = jnp.where(svalid, sender_ids, n)
+    sidx = jnp.where(svalid, sender_ids, 0)
+    sf = friends.at[sidx].get()
+    scnt = jnp.where(svalid, friend_cnt[sidx], 0)
+    dk = _sender_keys(base_key, _rng.OP_DELAY, sticks, rows)
+    pk = _sender_keys(base_key, _rng.OP_DROP, sticks, rows)
+    delay = jnp.maximum(jax.vmap(
+        lambda kk: jax.random.randint(kk, (), cfg.delaylow, cfg.delayhigh,
+                                      dtype=I32))(dk), 1)
+    drop_p = epidemic.p_eff(cfg, cfg.droprate)
+    if drop_p <= 0.0:
+        drop = jnp.zeros(rows.shape + (k,), bool)
+    elif drop_p >= 1.0:
+        drop = jnp.ones(rows.shape + (k,), bool)
+    else:
+        drop = jax.vmap(
+            lambda kk: jax.random.bernoulli(kk, drop_p, (k,)))(pk)
+    arrive = sticks + delay
+    wslot = (arrive // b) % dw
+    off = arrive % b
+    edge = (jnp.arange(k, dtype=I32)[None, :] < scnt[:, None]) \
+        & svalid[:, None] & ~drop & (sf >= 0)
+    # Per-sender rank among same-window-slot senders (emission order).
+    oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+          & svalid[:, None]).astype(I32)
+    srank = jnp.take_along_axis(
+        jnp.cumsum(oh, axis=0), jnp.where(svalid, wslot, 0)[:, None],
+        axis=1)[:, 0] - 1
+    base = mail_cnt[jnp.where(svalid, wslot, 0)]
+    start = base + srank * k
+    ok = svalid & (start + k <= cap)
+    flat = (jnp.where(ok, wslot, 0)[:, None] * cap + start[:, None]
+            + jnp.arange(k, dtype=I32)[None, :])
+    flat = jnp.where(ok[:, None], flat, dw * cap)  # -> in-bounds trash cell
+    payload = jnp.where(edge, sf * b + off[:, None], n * b)
+    mail_ids = mail_ids.at[flat.reshape(-1)].set(payload.reshape(-1))
+    # Overflowed senders are a per-slot suffix (start grows with rank), so
+    # counting only written reservations keeps positions contiguous.
+    adds = (oh * ok[:, None]).sum(axis=0) * k
+    new_cnt = mail_cnt + adds
+    lost = (edge & ~ok[:, None]).sum(dtype=I32)  # real edges, not padding
+    return mail_ids, new_cnt, dropped + lost
+
+
+def make_window_step_fn(cfg: Config):
+    """One B-tick window transition: drain this window's packed list in
+    chunks; per chunk sort by (id, crash-first, tick), crash/infect on run
+    firsts, and emit the newly infected nodes' broadcasts at their actual
+    delivery ticks."""
+    b = batch_ticks(cfg)
+    dw = ring_windows(cfg)
+    ccap = drain_chunk(cfg)
+    crash_p = epidemic.p_eff(cfg, cfg.crashrate)
+
+    def step_fn(st: EventState, base_key: jax.Array) -> EventState:
+        n = st.received.shape[0]
+        w = st.tick // b
+        slot = w % dw
+        m = st.mail_cnt[slot]
+        chunks = (m + ccap - 1) // ccap
+        ckey = _rng.tick_key(base_key, w, _rng.OP_CRASH)
+
+        def body(j, carry):
+            (received, crashed, mail_ids, mail_cnt,
+             dm, dr, dc, dropped) = carry
+            off0 = j * ccap
+            entry_pos = off0 + jnp.arange(ccap, dtype=I32)
+            evalid = entry_pos < m
+            cap = (mail_ids.shape[0] - ccap) // dw
+            packed = jax.lax.dynamic_slice(
+                mail_ids, (slot * cap + off0,), (ccap,))
+            packed = jnp.where(evalid, packed, n * b)  # sentinel sorts last
+            if crash_p > 0.0:
+                # Per-message draw keyed by mailbox position (append order
+                # is deterministic), like the reference's per-reception
+                # draw.  Secondary sort key (no-crash, tick_off): if ANY
+                # draw fired the run's first entry carries it; otherwise
+                # the first entry is the earliest delivery.
+                ck = _rng.row_keys(ckey, entry_pos)
+                draw = jax.vmap(
+                    lambda kk: jax.random.bernoulli(kk, crash_p))(ck)
+                crash_e = draw & evalid
+                sub = (1 - crash_e.astype(I32)) * b + packed % b
+                packed_s, sub_s = jax.lax.sort(
+                    (packed // b * b, sub), num_keys=2)
+                ids_s = packed_s // b
+                toff_s = sub_s % b
+                crash_s = sub_s < b
+            else:
+                packed_s = jnp.sort(packed)
+                ids_s = packed_s // b
+                toff_s = packed_s % b
+                crash_s = jnp.zeros((ccap,), bool)
+            valid_s = ids_s < n
+            idx = jnp.where(valid_s, ids_s, 0)
+            pre_recv = received[idx]
+            if crash_p > 0.0:
+                pre_crash = crashed[idx] & valid_s
+            else:
+                pre_crash = jnp.zeros((ccap,), bool)
+            counted = valid_s & ~pre_crash
+            dm = dm + counted.sum(dtype=I32)
+            prev = jnp.concatenate([jnp.full((1,), -1, I32), ids_s[:-1]])
+            first = (ids_s != prev) & valid_s
+            if crash_p > 0.0:
+                run_crash = first & crash_s & ~pre_crash
+                dc = dc + run_crash.sum(dtype=I32)
+                crashed = crashed.at[jnp.where(run_crash, ids_s, n)].max(
+                    True, mode="drop")
+            newly = first & counted & ~pre_recv & ~crash_s
+            dr = dr + newly.sum(dtype=I32)
+            received = received.at[jnp.where(newly, ids_s, n)].max(
+                True, mode="drop")
+            # Newly infected nodes broadcast at their delivery tick
+            # (simulator.go:120-122).
+            sidx = jnp.nonzero(newly, size=ccap, fill_value=ccap)[0]
+            sids = ids_s.at[sidx].get(mode="fill", fill_value=-1)
+            stoff = toff_s.at[sidx].get(mode="fill", fill_value=0)
+            mail_ids, mail_cnt, dropped = append_messages(
+                cfg, mail_ids, mail_cnt, dropped, jnp.maximum(sids, 0),
+                sids >= 0, w * b + stoff, st.friends, st.friend_cnt,
+                base_key)
+            return (received, crashed, mail_ids, mail_cnt, dm, dr, dc,
+                    dropped)
+
+        z = jnp.zeros((), I32)
+        (received, crashed, mail_ids, mail_cnt, dm, dr, dc,
+         dropped) = jax.lax.fori_loop(
+            0, chunks, body,
+            (st.received, st.crashed, st.mail_ids, st.mail_cnt, z, z, z,
+             st.mail_dropped))
+        mail_cnt = mail_cnt.at[slot].set(0)
+        return st._replace(
+            received=received, crashed=crashed, mail_ids=mail_ids,
+            mail_cnt=mail_cnt, tick=st.tick + b,
+            total_message=st.total_message + dm,
+            total_received=st.total_received + dr,
+            total_crashed=st.total_crashed + dc,
+            mail_dropped=dropped)
+
+    return step_fn
+
+
+def make_seed_fn(cfg: Config):
+    """Uniform-random sender's initial broadcast (simulator.go:240-241),
+    through the same append path as every later wave.  Uses the ring
+    engine's SEED_TICK-keyed streams: a dedicated one-sender append so the
+    seed's delay/drop draws do not depend on tick-0 window state."""
+
+    def seed_fn(st: EventState, base_key: jax.Array) -> EventState:
+        n = st.received.shape[0]
+        b = batch_ticks(cfg)
+        dw = ring_windows(cfg)
+        cap = (st.mail_ids.shape[0] - drain_chunk(cfg)) // dw
+        ks = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_SEED_NODE)
+        kd = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DELAY)
+        kp = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DROP)
+        sender = jax.random.randint(ks, (), 0, n, dtype=I32)
+        received, total_received = st.received, st.total_received
+        if not cfg.compat_reference:
+            # Reference quirk: the seed itself is never marked received
+            # (SURVEY §5.4); we count it unless compat is requested.
+            received = received.at[sender].set(True)
+            total_received = total_received + 1
+        k = st.friends.shape[1]
+        sf = st.friends[sender]
+        scnt = st.friend_cnt[sender]
+        delay = jnp.maximum(
+            jax.random.randint(jax.random.fold_in(kd, sender), (),
+                               cfg.delaylow, cfg.delayhigh, dtype=I32), 1)
+        drop = _rng.bernoulli(jax.random.fold_in(kp, sender),
+                              epidemic.p_eff(cfg, cfg.droprate), (k,))
+        arrive = st.tick + delay
+        wslot = (arrive // b) % dw
+        edge = (jnp.arange(k, dtype=I32) < scnt) & ~drop & (sf >= 0)
+        payload = jnp.where(edge, sf * b + arrive % b, n * b)
+        base = st.mail_cnt[wslot]
+        flat = wslot * cap + base + jnp.arange(k, dtype=I32)
+        ok = base + k <= cap
+        mail_ids = st.mail_ids.at[
+            jnp.where(ok, flat, dw * cap)].set(payload)  # trash cell if !ok
+        mail_cnt = st.mail_cnt.at[wslot].add(jnp.where(ok, k, 0))
+        dropped = st.mail_dropped + jnp.where(ok, 0, edge.sum(dtype=I32))
+        return st._replace(received=received, total_received=total_received,
+                           mail_ids=mail_ids, mail_cnt=mail_cnt,
+                           mail_dropped=dropped)
+
+    return seed_fn
+
+
+def make_window_fn(cfg: Config, window: int):
+    """Advance ~`window` simulated ms as one device call (the driver's poll
+    cadence): ceil(window / B) batched window steps."""
+    step = make_window_step_fn(cfg)
+    steps = max(1, -(-window // batch_ticks(cfg)))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def window_fn(st: EventState, base_key: jax.Array) -> EventState:
+        return jax.lax.fori_loop(0, steps, lambda _, s: step(s, base_key), st)
+
+    return window_fn
+
+
+def make_run_to_coverage_fn(cfg: Config):
+    """Bounded device-side while_loop, same contract as the ring engine's
+    (epidemic.make_run_to_coverage_fn / base.run_bounded_to_target)."""
+    step = make_window_step_fn(cfg)
+    max_steps = cfg.max_rounds
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_fn(st: EventState, base_key: jax.Array, target_count: jax.Array,
+               until: jax.Array) -> EventState:
+        def cond(s: EventState):
+            return ((s.total_received < target_count)
+                    & (s.tick < max_steps) & (s.tick < until))
+
+        def body(s: EventState):
+            return step(s, base_key)
+
+        return jax.lax.while_loop(cond, body, st)
+
+    return run_fn
+
+
+def in_flight(st) -> jnp.ndarray:
+    """Messages still undelivered -- engine-agnostic (EventState or the ring
+    engine's SimState)."""
+    if hasattr(st, "mail_cnt"):
+        return st.mail_cnt.sum()
+    return st.pending.sum() + st.rebroadcast.sum()

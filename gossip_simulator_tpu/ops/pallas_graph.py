@@ -38,15 +38,20 @@ BLOCK_ROWS = 512
 LANES = 128  # minimum last-dim tile; k columns are sliced out afterwards
 
 
-def _kout_kernel(n: int, row0: int, seed_ref, out_ref):
+def _kout_kernel(n: int, k: int, row0: int, seed_ref, out_ref):
     blk = pl.program_id(0)
     # Seed by GLOBAL block index so a row0>0 slice reproduces exactly the
     # same rows as the corresponding blocks of a full generation.
     pltpu.prng_seed(seed_ref[0], row0 // BLOCK_ROWS + blk)
-    bits = pltpu.prng_random_bits((BLOCK_ROWS, LANES))
+    # The output is TRANSPOSED (k, rows): a (rows, k) pallas output gets the
+    # forced T(8,128) tiled layout, padding k<=6 lanes out to 128 -- 51 GB
+    # of HBM at rows=1e8.  With rows on the lane axis the padding is only
+    # k -> 8 sublanes; the caller transposes back to the natural compact
+    # (rows, k) on the XLA side.
+    bits = pltpu.prng_random_bits((k, BLOCK_ROWS))
     peers = (bits.astype(jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
     gid = (row0 + blk * BLOCK_ROWS
-           + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_ROWS, LANES), 0))
+           + jax.lax.broadcasted_iota(jnp.int32, (k, BLOCK_ROWS), 1))
     out_ref[:] = jnp.where(peers == gid, (peers + 1) % n, peers)
 
 
@@ -65,13 +70,13 @@ def kout_pallas(n: int, k: int, row0: int, rows: int, seed,
     nblocks = -(-rows // BLOCK_ROWS)
     seed_arr = jnp.asarray(seed, dtype=jnp.int32).reshape((1,))
     out = pl.pallas_call(
-        functools.partial(_kout_kernel, n, row0),
+        functools.partial(_kout_kernel, n, k, row0),
         grid=(nblocks,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((k, BLOCK_ROWS), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((nblocks * BLOCK_ROWS, LANES),
+        out_shape=jax.ShapeDtypeStruct((k, nblocks * BLOCK_ROWS),
                                        jnp.int32),
         interpret=pltpu.InterpretParams() if interpret else False,
     )(seed_arr)
-    return out[:rows, :k]
+    return out[:, :rows].T

@@ -12,8 +12,11 @@ from gossip_simulator_tpu.utils.metrics import ProgressPrinter
 
 
 def _pair(backend, **kw):
+    # engine="ring": compaction is a ring-engine feature; the auto default
+    # would route these SI/ticks configs to the event engine (which ignores
+    # `compact`) and make the comparison vacuous.
     base = dict(n=4000, graph="kout", fanout=6, crashrate=0.01, seed=5,
-                backend=backend, progress=False, **kw)
+                backend=backend, engine="ring", progress=False, **kw)
     on = run_simulation(Config(**base, compact="on").validate(),
                         printer=ProgressPrinter(False))
     off = run_simulation(Config(**base, compact="off").validate(),
