@@ -32,7 +32,13 @@ class JaxStepper(Stepper):
             if cfg.effective_time_mode == "ticks" else 1.0)
         self._overlay_rounds = 0
         self.exhausted = False
-        if cfg.graph == "overlay":
+        if cfg.resume:
+            # State arrives via load_state_pytree; building a graph (or the
+            # phase-1 overlay buffers) here would be thrown away -- minutes
+            # and GBs at 1e8 nodes.
+            self.state = None
+            self._overlay_done = True
+        elif cfg.graph == "overlay":
             self._oround = jax.jit(overlay.make_round_fn(cfg))
             self.ostate = overlay.init_state(cfg)
             self._overlay_done = False

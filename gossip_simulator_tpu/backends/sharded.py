@@ -38,10 +38,22 @@ class ShardedStepper(Stepper):
         self.exhausted = False
         self._mailbox_dropped = 0
         self._window = 1 if cfg.effective_time_mode == "rounds" else WINDOW_MS
-        self._window_fn = sharded_step.make_window_fn(cfg, self.mesh,
-                                                      self._window)
-        self._seed_fn = sharded_step.make_seed_fn(cfg, self.mesh)
-        self._run_fn = sharded_step.make_run_to_coverage_fn(cfg, self.mesh)
+        if cfg.engine_resolved == "event":
+            from gossip_simulator_tpu.parallel import event_sharded
+
+            self._window_fn = event_sharded.make_window_fn(
+                cfg, self.mesh, self._window)
+            self._seed_fn = event_sharded.make_seed_fn(cfg, self.mesh)
+            self._run_fn = event_sharded.make_run_to_coverage_fn(
+                cfg, self.mesh)
+            init_fn = event_sharded.make_sharded_event_init
+        else:
+            self._window_fn = sharded_step.make_window_fn(cfg, self.mesh,
+                                                          self._window)
+            self._seed_fn = sharded_step.make_seed_fn(cfg, self.mesh)
+            self._run_fn = sharded_step.make_run_to_coverage_fn(
+                cfg, self.mesh)
+            init_fn = sharded_step.make_sharded_init
         if cfg.graph == "overlay":
             self._oround = sharded_step.make_overlay_round_fn(cfg, self.mesh)
             self.ostate = sharded_step.make_sharded_overlay_init(
@@ -49,7 +61,7 @@ class ShardedStepper(Stepper):
             self._overlay_done = False
             self.state = None
         else:
-            self._init_fn = sharded_step.make_sharded_init(cfg, self.mesh)
+            self._init_fn = init_fn(cfg, self.mesh)
             self.state = self._init_fn()
             self._overlay_done = True
 
@@ -75,12 +87,20 @@ class ShardedStepper(Stepper):
         n_local = shard_size(cfg.n, mesh)
         from jax.sharding import PartitionSpec as P
 
-        def build(friends, cnt):
-            return epidemic.init_state(cfg, friends, cnt, n_local=n_local)
+        if cfg.engine_resolved == "event":
+            from gossip_simulator_tpu.models import event as _event
+            from gossip_simulator_tpu.parallel import event_sharded
 
-        fn = jax.shard_map(build, mesh=mesh,
+            build = _event.init_state
+            out_specs = event_sharded.event_state_specs()
+        else:
+            def build(c, friends, cnt):
+                return epidemic.init_state(c, friends, cnt, n_local=n_local)
+            out_specs = sharded_step.sim_state_specs()
+
+        fn = jax.shard_map(lambda f, c: build(cfg, f, c), mesh=mesh,
                            in_specs=(P("nodes", None), P("nodes")),
-                           out_specs=sharded_step.sim_state_specs(),
+                           out_specs=out_specs,
                            check_vma=False)
         return jax.jit(fn)(self.ostate.friends, self.ostate.friend_cnt)
 
@@ -89,10 +109,11 @@ class ShardedStepper(Stepper):
         self.state = self._seed_fn(self.state, self.key)
 
     def gossip_window(self) -> Stats:
+        from gossip_simulator_tpu.models.event import in_flight as _inflight
+
         self.state = self._window_fn(self.state, self.key)
         stats = self.stats()
-        in_flight = int(jax.device_get(
-            self.state.pending.sum() + self.state.rebroadcast.sum()))
+        in_flight = int(jax.device_get(_inflight(self.state)))
         self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
         return stats
 
@@ -112,13 +133,15 @@ class ShardedStepper(Stepper):
 
     def stats(self) -> Stats:
         st = self.state
-        tm, tr, tc, xo = jax.device_get(
+        extra = st.mail_dropped if hasattr(st, "mail_dropped") else 0
+        tm, tr, tc, xo, tick, dropped = jax.device_get(
             (st.total_message, st.total_received, st.total_crashed,
-             st.exchange_overflow))
+             st.exchange_overflow, st.tick, extra))
         return Stats(
-            n=self.cfg.n, round=int(jax.device_get(st.tick)),
+            n=self.cfg.n, round=int(tick),
             total_received=int(tr), total_message=int(tm),
-            total_crashed=int(tc), mailbox_dropped=self._mailbox_dropped,
+            total_crashed=int(tc),
+            mailbox_dropped=self._mailbox_dropped + int(dropped),
             exchange_overflow=int(xo),
         )
 

@@ -81,11 +81,12 @@ class Config:
     # epidemic.compact_chunk_cap).  Exposed mainly so tests can force the
     # multi-chunk path at small n.
     compact_chunk: int = -1
-    # Epidemic engine (single-device jax backend): "ring" keeps per-(slot,
+    # Epidemic engine (jax + sharded backends): "ring" keeps per-(slot,
     # node) arrival counts (O(n) per tick); "event" keeps per-slot message
-    # id-lists (O(arrivals) per tick -- models/event.py).  "auto" = event for
-    # SI in ticks mode on the jax backend (unless compact is explicitly
-    # set, a ring-engine request), ring otherwise.
+    # id-lists (O(arrivals) per tick -- models/event.py and
+    # parallel/event_sharded.py).  "auto" = event for SI in ticks mode on
+    # the jax/sharded backends (unless compact is explicitly set, a
+    # ring-engine request), ring otherwise.
     engine: str = "auto"
     # Event engine per-WINDOW-slot message capacity (-1 = auto: see
     # event.slot_cap -- 1.5*n*max_degree*B/delay_span, bounded by the SI
@@ -98,9 +99,15 @@ class Config:
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
+    # Append structured JSONL records (params, per-window progress, totals,
+    # wall-clock) to this path, alongside the reference-format stdout.
+    log_jsonl: str = ""
     # Checkpoint every k rounds to this directory (0 = off).
     checkpoint_every: int = 0
     checkpoint_dir: str = ""
+    # Resume the epidemic phase from the latest snapshot in checkpoint_dir
+    # (jax backend; skips overlay construction and seeding).
+    resume: bool = False
     progress: bool = True  # print reference-format progress lines
 
     # --- derived --------------------------------------------------------------
@@ -143,13 +150,13 @@ class Config:
 
     @property
     def engine_resolved(self) -> str:
-        """Event engine requires SI + ticks semantics and currently serves
-        the single-device jax backend; everything else uses the ring engine.
-        An explicit `-compact on` is a ring-engine request (the event engine
-        has no dense path to compact), so auto honors it."""
+        """Event engine requires SI + ticks semantics on the jax or sharded
+        backend; everything else uses the ring engine.  An explicit
+        `-compact on/off` is a ring-engine request (the event engine has no
+        dense path to compact), so auto honors it."""
         if self.engine == "event":
             return "event"
-        if (self.engine == "auto" and self.backend == "jax"
+        if (self.engine == "auto" and self.backend in ("jax", "sharded")
                 and self.protocol == "si"
                 and self.effective_time_mode == "ticks"
                 and self.compact == "auto"):
@@ -204,9 +211,9 @@ class Config:
             if self.protocol != "si" or self.effective_time_mode != "ticks":
                 raise ValueError(
                     "engine=event supports protocol=si in ticks mode only")
-            if self.backend not in ("jax",):
+            if self.backend not in ("jax", "sharded"):
                 raise ValueError(
-                    "engine=event currently requires backend=jax")
+                    "engine=event requires backend=jax or sharded")
         if self.time_mode not in TIME_MODES:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"
@@ -217,6 +224,11 @@ class Config:
             )
         if self.max_rounds < 1:
             raise ValueError(f"max_rounds must be >= 1, got {self.max_rounds}")
+        if self.resume:
+            if not self.checkpoint_dir:
+                raise ValueError("-resume requires -checkpoint-dir")
+            if self.backend != "jax":
+                raise ValueError("-resume currently requires backend=jax")
         if self.fanout >= self.n:
             raise ValueError(f"fanout ({self.fanout}) must be < n ({self.n})")
         return self
@@ -291,10 +303,15 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)
+    p.add_argument("-log-jsonl", "--log-jsonl", dest="log_jsonl",
+                   default=d.log_jsonl,
+                   help="append structured JSONL progress records here")
     p.add_argument("-checkpoint-every", "--checkpoint-every",
                    dest="checkpoint_every", type=int, default=0)
     p.add_argument("-checkpoint-dir", "--checkpoint-dir", dest="checkpoint_dir",
                    default="")
+    p.add_argument("-resume", "--resume", action="store_true",
+                   help="resume from the latest snapshot in -checkpoint-dir")
     p.add_argument("-quiet", "--quiet", action="store_true",
                    help="suppress per-window progress lines")
     return p

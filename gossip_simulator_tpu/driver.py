@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 from typing import Optional
 
 from gossip_simulator_tpu.backends import make_stepper
@@ -31,36 +32,62 @@ class RunResult:
 def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
                    stepper: Optional[Stepper] = None) -> RunResult:
     cfg = cfg.validate()
-    printer = printer or ProgressPrinter(enabled=cfg.progress)
+    own_printer = printer is None
+    printer = printer or ProgressPrinter(enabled=cfg.progress,
+                                         jsonl_path=cfg.log_jsonl or None)
     stepper = stepper or make_stepper(cfg)
 
     printer.params(cfg.parameter_dump())
     stepper.init()
 
+    # --- Resume: skip straight into phase 2 from a snapshot -------------------
+    resumed = False
+    resume_window = 0
+    if cfg.resume:
+        from gossip_simulator_tpu.utils import checkpoint
+
+        path = checkpoint.latest(cfg.checkpoint_dir)
+        if path is None:
+            raise FileNotFoundError(
+                f"-resume: no snapshot found in {cfg.checkpoint_dir}")
+        tree, meta = checkpoint.load(path)
+        stepper.load_state_pytree(tree)
+        resume_window = int(meta.get("window", 0))
+        printer.section(f"Resumed from {os.path.basename(path)} "
+                        f"(window {resume_window})")
+        resumed = True
+
     # --- Phase 1: overlay (simulator.go:219-235) ------------------------------
-    printer.section("Constructing Overlay")
     overlay_windows = 0
-    max_overlay_windows = max(cfg.max_rounds, 1000)
-    while True:
-        makeups, breakups, quiesced = stepper.overlay_window()
-        overlay_windows += 1
-        if quiesced:
-            break
-        # Reference prints the window line only when *not* quiescing
-        # (simulator.go:227-230).
-        printer.overlay_window(breakups, makeups, stepper.sim_time_ms())
-        if overlay_windows >= max_overlay_windows:
-            raise RuntimeError(
-                f"overlay did not stabilize within {max_overlay_windows} windows")
-    stabilize_ms = stepper.sim_time_ms()
-    printer.stabilized(stabilize_ms)
+    if not resumed:
+        printer.section("Constructing Overlay")
+        max_overlay_windows = max(cfg.max_rounds, 1000)
+        while True:
+            makeups, breakups, quiesced = stepper.overlay_window()
+            overlay_windows += 1
+            if quiesced:
+                break
+            # Reference prints the window line only when *not* quiescing
+            # (simulator.go:227-230).
+            printer.overlay_window(breakups, makeups, stepper.sim_time_ms())
+            if overlay_windows >= max_overlay_windows:
+                raise RuntimeError(
+                    f"overlay did not stabilize within {max_overlay_windows} "
+                    f"windows")
+    stabilize_ms = 0.0 if resumed else stepper.sim_time_ms()
+    if not resumed:
+        printer.stabilized(stabilize_ms)
 
     # --- Phase 2: broadcast (simulator.go:237-253) ----------------------------
     printer.section("Broadcast one message")
-    stepper.seed()
+    if not resumed:
+        stepper.seed()
     target = cfg.coverage_target
     window_rounds = WINDOW_MS if cfg.effective_time_mode == "ticks" else 1
-    max_windows = max(1, cfg.max_rounds // window_rounds)
+    # max_rounds is an ABSOLUTE simulated-time cap (the engines enforce
+    # tick < max_rounds too): a resumed run only gets the remainder.
+    elapsed = int(stepper.sim_time_ms()) if resumed else 0
+    max_windows = max(1, (cfg.max_rounds - elapsed) // window_rounds)
     gossip_windows = 0
     converged = False
     ckpt = _Checkpointer(cfg, stepper)
@@ -70,7 +97,9 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
             gossip_windows += 1
             pct = stats.coverage * 100.0
             printer.coverage_window(round(pct, 4), stepper.sim_time_ms())
-            ckpt.maybe_save(gossip_windows, stats)
+            # Offset by the restored window so post-resume snapshot numbers
+            # continue the sequence (checkpoint.latest is lexicographic).
+            ckpt.maybe_save(resume_window + gossip_windows, stats)
             if stats.coverage >= target:
                 converged = True
                 break
@@ -81,6 +110,8 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     coverage_ms = stepper.sim_time_ms()
     stats = stepper.stats()
     printer.done(coverage_ms, stats, target_pct=target * 100.0, converged=converged)
+    if own_printer:
+        printer.close()
     return RunResult(
         stats=stats,
         stabilize_ms=stabilize_ms,

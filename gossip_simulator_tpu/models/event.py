@@ -87,12 +87,16 @@ class EventState(NamedTuple):
     # full drain_chunk of slack keeps the last drain slice of a full slot
     # from clamping (clamped dynamic_slice would misalign entry validity).
     mail_ids: jnp.ndarray  # int32[dw * cap + drain_chunk]
-    mail_cnt: jnp.ndarray  # int32[dw]
+    # (1, dw): node-axis-leading so the sharded backend stacks shards'
+    # counts to (S, dw) under a P('nodes', None) spec.
+    mail_cnt: jnp.ndarray  # int32[1, dw]
     tick: jnp.ndarray  # int32[]
     total_message: jnp.ndarray  # int32[]
     total_received: jnp.ndarray  # int32[]
     total_crashed: jnp.ndarray  # int32[]
     mail_dropped: jnp.ndarray  # int32[]  slot-capacity overflow (counted)
+    # Cross-shard all_to_all bucket overflow (always 0 on one device).
+    exchange_overflow: jnp.ndarray  # int32[]
 
 
 def batch_ticks(cfg: Config, n_local: int | None = None) -> int:
@@ -127,16 +131,16 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
     return min(cap, (2**31 - 1) // max(dw, 1))
 
 
-def drain_chunk(cfg: Config) -> int:
+def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
     """Drain chunk size: large, because per-op dispatch overhead (not element
     count) dominates chunk cost on this platform."""
     want = cfg.event_chunk if cfg.event_chunk > 0 else 524_288
-    return min(slot_cap(cfg), max(256, want))
+    return min(slot_cap(cfg, n_local), max(256, want))
 
 
 def init_state(cfg: Config, friends: jnp.ndarray,
                friend_cnt: jnp.ndarray) -> EventState:
-    n = friends.shape[0]
+    n = friends.shape[0]  # local rows: the shard slice under the sharded backend
     z = lambda: jnp.zeros((), I32)
     return EventState(
         received=jnp.zeros((n,), bool),
@@ -144,10 +148,11 @@ def init_state(cfg: Config, friends: jnp.ndarray,
         friends=friends,
         friend_cnt=friend_cnt,
         mail_ids=jnp.zeros(
-            (ring_windows(cfg) * slot_cap(cfg) + drain_chunk(cfg),), I32),
-        mail_cnt=jnp.zeros((ring_windows(cfg),), I32),
+            (ring_windows(cfg) * slot_cap(cfg, n) + drain_chunk(cfg, n),),
+            I32),
+        mail_cnt=jnp.zeros((1, ring_windows(cfg)), I32),
         tick=z(), total_message=z(), total_received=z(), total_crashed=z(),
-        mail_dropped=z(),
+        mail_dropped=z(), exchange_overflow=z(),
     )
 
 
@@ -173,7 +178,7 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     1-D mode="drop" scatter."""
     n, k = friends.shape
     dw = ring_windows(cfg)
-    cap = (mail_ids.shape[0] - drain_chunk(cfg)) // dw
+    cap = (mail_ids.shape[0] - drain_chunk(cfg, n)) // dw
     b = batch_ticks(cfg)
     rows = jnp.where(svalid, sender_ids, n)
     sidx = jnp.where(svalid, sender_ids, 0)
@@ -203,7 +208,7 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     srank = jnp.take_along_axis(
         jnp.cumsum(oh, axis=0), jnp.where(svalid, wslot, 0)[:, None],
         axis=1)[:, 0] - 1
-    base = mail_cnt[jnp.where(svalid, wslot, 0)]
+    base = mail_cnt[0, jnp.where(svalid, wslot, 0)]
     start = base + srank * k
     ok = svalid & (start + k <= cap)
     flat = (jnp.where(ok, wslot, 0)[:, None] * cap + start[:, None]
@@ -214,26 +219,77 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     # Overflowed senders are a per-slot suffix (start grows with rank), so
     # counting only written reservations keeps positions contiguous.
     adds = (oh * ok[:, None]).sum(axis=0) * k
-    new_cnt = mail_cnt + adds
+    new_cnt = mail_cnt + adds[None, :]
     lost = (edge & ~ok[:, None]).sum(dtype=I32)  # real edges, not padding
     return mail_ids, new_cnt, dropped + lost
 
 
-def make_window_step_fn(cfg: Config):
+def drain_chunk_core(crash_p: float, b: int, n_rows: int, received, crashed,
+                     packed, evalid, entry_pos, ckey):
+    """Crash/infect/dedupe one drained chunk of packed entries (shared by the
+    single-device and sharded engines; `n_rows` is the local row count).
+
+    Sorts by (id, crash-fired-first, tick_off): a node's entries become one
+    contiguous run whose FIRST element answers whether any per-message crash
+    draw fired (keyed by mailbox position -- append order is deterministic --
+    like the reference's per-reception draw, simulator.go:112-116) and, if
+    not, its earliest delivery tick.
+
+    Returns (received, crashed, dm, dr, dc, ids_s, toff_s, newly)."""
+    ccap = packed.shape[0]
+    packed = jnp.where(evalid, packed, n_rows * b)  # sentinel sorts last
+    if crash_p > 0.0:
+        ck = _rng.row_keys(ckey, entry_pos)
+        draw = jax.vmap(lambda kk: jax.random.bernoulli(kk, crash_p))(ck)
+        crash_e = draw & evalid
+        sub = (1 - crash_e.astype(I32)) * b + packed % b
+        packed_s, sub_s = jax.lax.sort((packed // b * b, sub), num_keys=2)
+        ids_s = packed_s // b
+        toff_s = sub_s % b
+        crash_s = sub_s < b
+    else:
+        packed_s = jnp.sort(packed)
+        ids_s = packed_s // b
+        toff_s = packed_s % b
+        crash_s = jnp.zeros((ccap,), bool)
+    valid_s = ids_s < n_rows
+    idx = jnp.where(valid_s, ids_s, 0)
+    pre_recv = received[idx]
+    if crash_p > 0.0:
+        pre_crash = crashed[idx] & valid_s
+    else:
+        pre_crash = jnp.zeros((ccap,), bool)
+    counted = valid_s & ~pre_crash
+    dm = counted.sum(dtype=I32)
+    prev = jnp.concatenate([jnp.full((1,), -1, I32), ids_s[:-1]])
+    first = (ids_s != prev) & valid_s
+    dc = jnp.zeros((), I32)
+    if crash_p > 0.0:
+        run_crash = first & crash_s & ~pre_crash
+        dc = run_crash.sum(dtype=I32)
+        crashed = crashed.at[jnp.where(run_crash, ids_s, n_rows)].max(
+            True, mode="drop")
+    newly = first & counted & ~pre_recv & ~crash_s
+    dr = newly.sum(dtype=I32)
+    received = received.at[jnp.where(newly, ids_s, n_rows)].max(
+        True, mode="drop")
+    return received, crashed, dm, dr, dc, ids_s, toff_s, newly
+
+
+def make_window_step_fn(cfg: Config, n_local: int | None = None):
     """One B-tick window transition: drain this window's packed list in
-    chunks; per chunk sort by (id, crash-first, tick), crash/infect on run
-    firsts, and emit the newly infected nodes' broadcasts at their actual
-    delivery ticks."""
+    chunks (drain_chunk_core), and emit the newly infected nodes' broadcasts
+    at their actual delivery ticks."""
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
-    ccap = drain_chunk(cfg)
+    ccap = drain_chunk(cfg, n_local)
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.received.shape[0]
         w = st.tick // b
         slot = w % dw
-        m = st.mail_cnt[slot]
+        m = st.mail_cnt[0, slot]
         chunks = (m + ccap - 1) // ccap
         ckey = _rng.tick_key(base_key, w, _rng.OP_CRASH)
 
@@ -246,48 +302,10 @@ def make_window_step_fn(cfg: Config):
             cap = (mail_ids.shape[0] - ccap) // dw
             packed = jax.lax.dynamic_slice(
                 mail_ids, (slot * cap + off0,), (ccap,))
-            packed = jnp.where(evalid, packed, n * b)  # sentinel sorts last
-            if crash_p > 0.0:
-                # Per-message draw keyed by mailbox position (append order
-                # is deterministic), like the reference's per-reception
-                # draw.  Secondary sort key (no-crash, tick_off): if ANY
-                # draw fired the run's first entry carries it; otherwise
-                # the first entry is the earliest delivery.
-                ck = _rng.row_keys(ckey, entry_pos)
-                draw = jax.vmap(
-                    lambda kk: jax.random.bernoulli(kk, crash_p))(ck)
-                crash_e = draw & evalid
-                sub = (1 - crash_e.astype(I32)) * b + packed % b
-                packed_s, sub_s = jax.lax.sort(
-                    (packed // b * b, sub), num_keys=2)
-                ids_s = packed_s // b
-                toff_s = sub_s % b
-                crash_s = sub_s < b
-            else:
-                packed_s = jnp.sort(packed)
-                ids_s = packed_s // b
-                toff_s = packed_s % b
-                crash_s = jnp.zeros((ccap,), bool)
-            valid_s = ids_s < n
-            idx = jnp.where(valid_s, ids_s, 0)
-            pre_recv = received[idx]
-            if crash_p > 0.0:
-                pre_crash = crashed[idx] & valid_s
-            else:
-                pre_crash = jnp.zeros((ccap,), bool)
-            counted = valid_s & ~pre_crash
-            dm = dm + counted.sum(dtype=I32)
-            prev = jnp.concatenate([jnp.full((1,), -1, I32), ids_s[:-1]])
-            first = (ids_s != prev) & valid_s
-            if crash_p > 0.0:
-                run_crash = first & crash_s & ~pre_crash
-                dc = dc + run_crash.sum(dtype=I32)
-                crashed = crashed.at[jnp.where(run_crash, ids_s, n)].max(
-                    True, mode="drop")
-            newly = first & counted & ~pre_recv & ~crash_s
-            dr = dr + newly.sum(dtype=I32)
-            received = received.at[jnp.where(newly, ids_s, n)].max(
-                True, mode="drop")
+            received, crashed, cdm, cdr, cdc, ids_s, toff_s, newly = \
+                drain_chunk_core(crash_p, b, n, received, crashed, packed,
+                                 evalid, entry_pos, ckey)
+            dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
             # Newly infected nodes broadcast at their delivery tick
             # (simulator.go:120-122).
             sidx = jnp.nonzero(newly, size=ccap, fill_value=ccap)[0]
@@ -306,7 +324,7 @@ def make_window_step_fn(cfg: Config):
             0, chunks, body,
             (st.received, st.crashed, st.mail_ids, st.mail_cnt, z, z, z,
              st.mail_dropped))
-        mail_cnt = mail_cnt.at[slot].set(0)
+        mail_cnt = mail_cnt.at[0, slot].set(0)
         return st._replace(
             received=received, crashed=crashed, mail_ids=mail_ids,
             mail_cnt=mail_cnt, tick=st.tick + b,
@@ -328,7 +346,7 @@ def make_seed_fn(cfg: Config):
         n = st.received.shape[0]
         b = batch_ticks(cfg)
         dw = ring_windows(cfg)
-        cap = (st.mail_ids.shape[0] - drain_chunk(cfg)) // dw
+        cap = (st.mail_ids.shape[0] - drain_chunk(cfg, n)) // dw
         ks = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_SEED_NODE)
         kd = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DELAY)
         kp = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DROP)
@@ -351,12 +369,12 @@ def make_seed_fn(cfg: Config):
         wslot = (arrive // b) % dw
         edge = (jnp.arange(k, dtype=I32) < scnt) & ~drop & (sf >= 0)
         payload = jnp.where(edge, sf * b + arrive % b, n * b)
-        base = st.mail_cnt[wslot]
+        base = st.mail_cnt[0, wslot]
         flat = wslot * cap + base + jnp.arange(k, dtype=I32)
         ok = base + k <= cap
         mail_ids = st.mail_ids.at[
             jnp.where(ok, flat, dw * cap)].set(payload)  # trash cell if !ok
-        mail_cnt = st.mail_cnt.at[wslot].add(jnp.where(ok, k, 0))
+        mail_cnt = st.mail_cnt.at[0, wslot].add(jnp.where(ok, k, 0))
         dropped = st.mail_dropped + jnp.where(ok, 0, edge.sum(dtype=I32))
         return st._replace(received=received, total_received=total_received,
                            mail_ids=mail_ids, mail_cnt=mail_cnt,

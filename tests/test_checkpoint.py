@@ -50,3 +50,39 @@ def test_driver_writes_checkpoints(tmp_path):
                  checkpoint_dir=str(tmp_path), progress=False).validate()
     run_simulation(cfg, printer=ProgressPrinter(enabled=False))
     assert checkpoint.latest(str(tmp_path)) is not None
+
+
+def test_driver_resume_flag(tmp_path):
+    """Interrupted run -> -resume from the latest snapshot completes."""
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    base = dict(n=2000, backend="jax", graph="kout", fanout=6, seed=3,
+                crashrate=0.0, checkpoint_dir=str(tmp_path), progress=False)
+    # "Interrupted": checkpoint every window, stop early via max_rounds.
+    partial = run_simulation(
+        Config(**base, checkpoint_every=1, max_rounds=30).validate(),
+        printer=ProgressPrinter(enabled=False))
+    assert not partial.converged
+    assert checkpoint.latest(str(tmp_path)) is not None
+    resumed = run_simulation(Config(**base, resume=True).validate(),
+                             printer=ProgressPrinter(enabled=False))
+    assert resumed.converged
+    assert resumed.stats.total_received >= partial.stats.total_received
+
+
+def test_resume_engine_mismatch_rejected(tmp_path):
+    cfg_ring = Config(n=2000, backend="jax", graph="kout", fanout=6, seed=3,
+                      engine="ring", progress=False).validate()
+    s = JaxStepper(cfg_ring)
+    s.init()
+    s.seed()
+    path = checkpoint.save(str(tmp_path), 1, s.state_pytree(), s.stats())
+    cfg_event = cfg_ring.replace(engine="event")
+    s2 = JaxStepper(cfg_event)
+    s2.init()
+    tree, _ = checkpoint.load(path)
+    import pytest
+
+    with pytest.raises(ValueError, match="ring engine"):
+        s2.load_state_pytree(tree)

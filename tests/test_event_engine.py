@@ -32,6 +32,11 @@ def test_auto_engine_selection():
     assert Config(**{**BASE, "time_mode": "rounds"}).validate() \
         .engine_resolved == "ring"
     assert Config(**{**BASE, "backend": "sharded", "n": 4000}).validate() \
+        .engine_resolved == "event"
+    assert Config(**{**BASE, "backend": "native"}).validate() \
+        .engine_resolved == "ring"
+    # Explicit compact is a ring-engine request.
+    assert Config(**{**BASE, "compact": "on"}).validate() \
         .engine_resolved == "ring"
     with pytest.raises(ValueError, match="engine=event"):
         Config(**{**BASE, "engine": "event", "protocol": "sir"}).validate()
@@ -127,6 +132,51 @@ def test_event_overlay_handoff():
     res, _ = _run(engine="event", graph="overlay", n=1200, fanout=5,
                   seed=4, coverage_target=0.9)
     assert res.converged
+
+
+def test_event_sharded_converges_and_matches_single_device():
+    """Sharded event engine on the 8-fake-device mesh: same physics,
+    per-shard RNG streams -- totals agree distributionally with the
+    single-device event engine, nothing lost in routing."""
+    sh, cfg = _run(backend="sharded", n=4000)
+    sj, _ = _run(backend="jax", n=4000)
+    assert cfg.engine_resolved == "event"
+    assert sh.converged and sj.converged
+    assert sh.stats.exchange_overflow == 0
+    assert sh.stats.mailbox_dropped == 0
+    expect = cfg.n * cfg.fanout * (1 - cfg.droprate)
+    assert sh.stats.total_message <= expect * 1.02
+    assert abs(sh.stats.total_message - sj.stats.total_message) / expect < 0.2
+    assert abs(sh.coverage_ms - sj.coverage_ms) <= 30
+
+
+def test_event_sharded_determinism():
+    r1, _ = _run(backend="sharded", n=4000, crashrate=0.01,
+                 coverage_target=0.9)
+    r2, _ = _run(backend="sharded", n=4000, crashrate=0.01,
+                 coverage_target=0.9)
+    assert r1.stats == r2.stats
+
+
+def test_event_sharded_overlay_handoff():
+    res, cfg = _run(backend="sharded", graph="overlay", n=2000, fanout=5,
+                    seed=3, coverage_target=0.9)
+    assert cfg.engine_resolved == "event"
+    assert res.converged
+
+
+def test_event_sharded_run_to_target_matches_windows():
+    cfg = Config(**{**BASE, "backend": "sharded", "n": 4000}).validate()
+    from gossip_simulator_tpu.backends.sharded import ShardedStepper
+
+    s = ShardedStepper(cfg)
+    s.init()
+    s.seed()
+    fast = s.run_to_target()
+    assert fast.coverage >= cfg.coverage_target
+    res, _ = _run(backend="sharded", n=4000)
+    assert fast.total_message == res.stats.total_message
+    assert fast.total_received == res.stats.total_received
 
 
 def test_event_checkpoint_roundtrip(tmp_path):
