@@ -60,23 +60,27 @@ def _bench_jax(cfg: Config) -> dict:
     }
 
 
-def _bench_native(cfg: Config, budget_s: float = 20.0) -> dict:
-    """Event-driven oracle rate in node-updates/sec on the same semantics.
-    Run at a feasible N, rate extrapolates linearly (it's O(messages))."""
-    s = NativeStepper(cfg)
+def _bench_oracle(cfg: Config, budget_s: float = 20.0) -> dict:
+    """Event-driven oracle rate in node-updates/sec on the same semantics
+    (backend 'native' = Python actor loop, 'cpp' = C++ discrete-event).
+    Run at a feasible N, rate extrapolates roughly linearly (O(messages))."""
+    if cfg.backend == "cpp":
+        from gossip_simulator_tpu.backends.cpp import CppStepper
+
+        s = CppStepper(cfg)
+    else:
+        s = NativeStepper(cfg)
     s.init()
     while not s.overlay_window()[2]:
         pass
     s.seed()
     t0 = time.perf_counter()
-    windows = 0
     while time.perf_counter() - t0 < budget_s:
         st = s.gossip_window()
-        windows += 1
         if st.coverage >= cfg.coverage_target or s.exhausted:
             break
     run_s = time.perf_counter() - t0
-    ticks = int(s.now - s.phase_start)
+    ticks = int(s.sim_time_ms())
     return {
         "n": cfg.n, "ticks": ticks, "run_s": run_s,
         "coverage": st.coverage,
@@ -97,20 +101,35 @@ def headline(n: int | None, seed: int) -> dict:
                  crashrate=0.001, coverage_target=0.90, max_rounds=3000,
                  progress=False).validate()
     jx = _bench_jax(cfg)
-    # Native baseline at a size the Python loop can handle.
-    ncfg = cfg.replace(n=min(n, 100_000), backend="native")
-    nat = _bench_native(ncfg)
-    vs = (jx["node_updates_per_sec"] / nat["node_updates_per_sec"]
-          if nat["node_updates_per_sec"] else 0.0)
+    # Two baselines, both part of this repo:
+    # * python actor loop ("native"): per-node actors + delayed deliveries,
+    #   the architecture-faithful stand-in for the reference's
+    #   goroutine-per-node design (Go toolchain absent here).
+    # * C++ discrete-event loop ("cpp"): the strongest single-core native
+    #   implementation of the same semantics -- the honest perf bar.
+    nat = _bench_oracle(cfg.replace(n=min(n, 100_000), backend="native"))
+    try:
+        cpp = _bench_oracle(cfg.replace(n=min(n, 1_000_000), backend="cpp"),
+                            budget_s=60.0)
+    except Exception as e:  # no g++ on this host: report python only
+        cpp = {"error": str(e), "node_updates_per_sec": 0.0}
+    vs_actor = (jx["node_updates_per_sec"] / nat["node_updates_per_sec"]
+                if nat["node_updates_per_sec"] else 0.0)
+    vs_cpp = (jx["node_updates_per_sec"] / cpp["node_updates_per_sec"]
+              if cpp["node_updates_per_sec"] else 0.0)
     return {
         "metric": "node_updates_per_sec_per_chip",
         "value": round(jx["node_updates_per_sec"], 1),
         "unit": "node_ticks/s",
-        "vs_baseline": round(vs, 2),
+        # vs the architecture-faithful actor loop (reference design).
+        "vs_baseline": round(vs_actor, 2),
+        # vs our optimized C++ discrete-event loop (strongest native tier).
+        "vs_cpp_event_loop": round(vs_cpp, 2),
         "detail": {
             "device": jax.devices()[0].device_kind,
             "jax": jx,
-            "native_baseline": nat,
+            "python_actor_baseline": nat,
+            "cpp_event_baseline": cpp,
         },
     }
 
@@ -126,11 +145,17 @@ def full_suite(seed: int) -> list[dict]:
         ("si_1k_fanout1", Config(n=1000, fanout=1, graph="kout",
                                  backend="native", seed=seed, progress=False,
                                  max_rounds=20000)),
+        # coverage 0.90: fanout 3 / drop 0.1 asymptotes at ~93% (headline
+        # rationale above).
         ("si_1m_fanout3", Config(n=1_000_000 // scale, fanout=3, graph="kout",
-                                 backend="jax", seed=seed, progress=False)),
+                                 backend="jax", seed=seed,
+                                 coverage_target=0.90, max_rounds=3000,
+                                 progress=False)),
+        # Anti-entropy gossips with fresh random peers each round; the
+        # static graph is irrelevant, so skip the overlay build phase.
         ("pushpull_10m_logn", Config(n=10_000_000 // scale,
                                      fanout=23, protocol="pushpull",
-                                     backend="jax", seed=seed,
+                                     graph="kout", backend="jax", seed=seed,
                                      progress=False)),
         ("sir_10m_erdos", Config(n=10_000_000 // scale, fanout=8,
                                  graph="erdos", protocol="sir",
@@ -144,7 +169,7 @@ def full_suite(seed: int) -> list[dict]:
         if cfg.backend == "jax":
             r = _bench_jax(cfg)
         else:
-            r = _bench_native(cfg, budget_s=60.0)
+            r = _bench_oracle(cfg, budget_s=60.0)
         r["config"] = name
         r["wall_s"] = round(time.perf_counter() - t0, 3)
         out.append(r)

@@ -130,15 +130,44 @@ class JaxStepper(Stepper):
         return {k: np.asarray(v) for k, v in self.state._asdict().items()}
 
     def load_state_pytree(self, tree) -> None:
+        from gossip_simulator_tpu.models import event as _event
         from gossip_simulator_tpu.models.event import EventState
         from gossip_simulator_tpu.models.state import SimState
 
+        cfg = self.cfg
         ckpt_engine = "event" if "mail_ids" in tree else "ring"
-        if ckpt_engine != self.cfg.engine_resolved:
+        if ckpt_engine != cfg.engine_resolved:
             raise ValueError(
                 f"checkpoint was written by the {ckpt_engine} engine but "
-                f"this run resolves to {self.cfg.engine_resolved}; pass "
+                f"this run resolves to {cfg.engine_resolved}; pass "
                 f"-engine {ckpt_engine} to restore it")
+        # Geometry check: ring layouts are decoded from cfg-derived constants
+        # (cap, dw, delay depth), so a snapshot written under different
+        # -n/-delayhigh/-event-* flags would silently mis-index.
+        n = int(tree["received"].shape[0])
+        if n != cfg.n:
+            raise ValueError(
+                f"checkpoint has n={n} but this run has n={cfg.n}")
+        if ckpt_engine == "event":
+            dw = _event.ring_windows(cfg)
+            want_mail = (dw * _event.slot_cap(cfg, n)
+                         + _event.drain_chunk(cfg, n),)
+            if (tuple(tree["mail_ids"].shape) != want_mail
+                    or tuple(tree["mail_cnt"].shape) != (1, dw)):
+                raise ValueError(
+                    "checkpoint mail-ring geometry "
+                    f"{tuple(tree['mail_ids'].shape)}/"
+                    f"{tuple(tree['mail_cnt'].shape)} does not match this "
+                    f"config's {want_mail}/(1, {dw}); restore with the "
+                    "same -delaylow/-delayhigh/-event-slot-cap/-event-chunk "
+                    "the snapshot was written with")
+        else:
+            d = epidemic.ring_depth(cfg)
+            if tuple(tree["pending"].shape) != (d, n):
+                raise ValueError(
+                    f"checkpoint delay ring {tuple(tree['pending'].shape)} "
+                    f"does not match this config's ({d}, {n}); restore with "
+                    "the snapshot's -delaylow/-delayhigh/-time-mode")
         cls = EventState if ckpt_engine == "event" else SimState
         self.state = cls(**{k: jax.numpy.asarray(v)
                             for k, v in tree.items()})

@@ -84,15 +84,15 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         stepper.seed()
     target = cfg.coverage_target
     window_rounds = WINDOW_MS if cfg.effective_time_mode == "ticks" else 1
-    # max_rounds is an ABSOLUTE simulated-time cap (the engines enforce
-    # tick < max_rounds too): a resumed run only gets the remainder.
+    # max_rounds is an ABSOLUTE simulated-time cap: a resumed run only gets
+    # the remainder, and a snapshot already at the cap runs zero windows.
     elapsed = int(stepper.sim_time_ms()) if resumed else 0
-    max_windows = max(1, (cfg.max_rounds - elapsed) // window_rounds)
+    max_windows = max(0, (cfg.max_rounds - elapsed) // window_rounds)
     gossip_windows = 0
     converged = False
     ckpt = _Checkpointer(cfg, stepper)
     with _maybe_profile(cfg):
-        while True:
+        while gossip_windows < max_windows:
             stats = stepper.gossip_window()
             gossip_windows += 1
             pct = stats.coverage * 100.0
@@ -103,12 +103,12 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
             if stats.coverage >= target:
                 converged = True
                 break
-            if gossip_windows >= max_windows:
-                break
             if getattr(stepper, "exhausted", False):
                 break  # no messages in flight and nothing can change
     coverage_ms = stepper.sim_time_ms()
     stats = stepper.stats()
+    # A snapshot restored at/after the cap may already be at target.
+    converged = converged or stats.coverage >= target
     printer.done(coverage_ms, stats, target_pct=target * 100.0, converged=converged)
     if own_printer:
         printer.close()

@@ -239,7 +239,9 @@ def make_sharded_event_seed(cfg: Config, mesh):
             received = received | (
                 (jnp.arange(n_local, dtype=I32) == srow) & own)
             total_received = total_received + 1  # replicated
-        rcap = exchange.epidemic_cap(n_local, kwidth, s)
+        # The seed emits at most kwidth messages total; a wave-sized route
+        # buffer here would allocate epidemic_cap (~GBs at 1e8) for nothing.
+        rcap = min(exchange.epidemic_cap(n_local, kwidth, s), kwidth)
         mail, cnt, dropped, xovf = _route_and_append(
             cfg, s, n_local, st.mail_ids, st.mail_cnt, jnp.zeros((), I32),
             jnp.zeros((), I32), jnp.where(edge, sf, 0),
