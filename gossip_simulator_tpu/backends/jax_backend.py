@@ -130,7 +130,6 @@ class JaxStepper(Stepper):
         return {k: np.asarray(v) for k, v in self.state._asdict().items()}
 
     def load_state_pytree(self, tree) -> None:
-        from gossip_simulator_tpu.models import event as _event
         from gossip_simulator_tpu.models.event import EventState
         from gossip_simulator_tpu.models.state import SimState
 
@@ -149,9 +148,9 @@ class JaxStepper(Stepper):
             raise ValueError(
                 f"checkpoint has n={n} but this run has n={cfg.n}")
         if ckpt_engine == "event":
-            dw = _event.ring_windows(cfg)
-            want_mail = (dw * _event.slot_cap(cfg, n)
-                         + _event.drain_chunk(cfg, n),)
+            dw = event.ring_windows(cfg)
+            want_mail = (dw * event.slot_cap(cfg, n)
+                         + event.drain_chunk(cfg, n),)
             if (tuple(tree["mail_ids"].shape) != want_mail
                     or tuple(tree["mail_cnt"].shape) != (1, dw)):
                 raise ValueError(

@@ -85,9 +85,11 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     target = cfg.coverage_target
     window_rounds = WINDOW_MS if cfg.effective_time_mode == "ticks" else 1
     # max_rounds is an ABSOLUTE simulated-time cap: a resumed run only gets
-    # the remainder, and a snapshot already at the cap runs zero windows.
+    # the remainder (ceil: a partial-window remainder still runs, matching
+    # the engines' own tick < max_rounds bound), and a snapshot already at
+    # the cap runs zero windows.
     elapsed = int(stepper.sim_time_ms()) if resumed else 0
-    max_windows = max(0, (cfg.max_rounds - elapsed) // window_rounds)
+    max_windows = max(0, -(-(cfg.max_rounds - elapsed) // window_rounds))
     gossip_windows = 0
     converged = False
     ckpt = _Checkpointer(cfg, stepper)

@@ -53,3 +53,25 @@ def test_jsonl_log(tmp_path):
     events = [json.loads(l) for l in p.read_text().splitlines()]
     kinds = {e["event"] for e in events}
     assert {"params", "coverage", "done", "totals"} <= kinds
+
+
+def test_log_jsonl_flag_via_config(tmp_path):
+    p = tmp_path / "flag.jsonl"
+    cfg = Config(n=1500, seed=1, backend="native", progress=False,
+                 log_jsonl=str(p)).validate()
+    run_simulation(cfg)
+    assert p.exists() and p.read_text().count("\n") >= 3
+
+
+def test_new_flags_parse_and_validate():
+    import pytest
+
+    from gossip_simulator_tpu.config import parse_args
+
+    cfg = parse_args(["-engine", "event", "-event-chunk", "1024",
+                      "-event-slot-cap", "5000", "-log-jsonl", "/tmp/x"])
+    assert cfg.engine == "event" and cfg.event_chunk == 1024
+    with pytest.raises(ValueError, match="resume requires"):
+        Config(resume=True).validate()
+    with pytest.raises(ValueError, match="engine=event"):
+        Config(engine="event", backend="native").validate()
