@@ -84,10 +84,11 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         stepper.seed()
     target = cfg.coverage_target
     window_rounds = WINDOW_MS if cfg.effective_time_mode == "ticks" else 1
-    # max_rounds is an ABSOLUTE simulated-time cap: a resumed run only gets
-    # the remainder (ceil: a partial-window remainder still runs, matching
-    # the engines' own tick < max_rounds bound), and a snapshot already at
-    # the cap runs zero windows.
+    # max_rounds caps simulated time at WINDOW granularity (both this loop
+    # and the engines' run_to_coverage while_loops advance whole windows
+    # between bound checks, so either path may overshoot the cap by up to
+    # window_rounds-1 ticks -- consistently).  A resumed run gets only the
+    # ceil of its remainder; a snapshot already at the cap runs zero windows.
     elapsed = int(stepper.sim_time_ms()) if resumed else 0
     max_windows = max(0, -(-(cfg.max_rounds - elapsed) // window_rounds))
     gossip_windows = 0

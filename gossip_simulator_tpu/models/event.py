@@ -128,6 +128,12 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
                             / max(cfg.delay_span, 1))))
     # One slot can never hold more than every SI message plus padding.
     cap = min(cap, n * cfg.max_degree + cfg.max_degree)
+    if cfg.event_slot_cap <= 0:
+        # Auto sizing also respects HBM: bound the whole ring to ~3 GB
+        # (validated headroom for the 100M single-chip run on a 16 GB v5e;
+        # overflow past the cap is counted in mail_dropped, never silent).
+        # An explicit -event-slot-cap overrides this.
+        cap = min(cap, (3 * 2**30) // (4 * max(dw, 1)))
     return min(cap, (2**31 - 1) // max(dw, 1))
 
 
