@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
+import warnings
 
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.config import Config
@@ -28,6 +30,14 @@ _GRAPH = {"overlay": 0, "kout": 1, "erdos": 2, "ring": 3}
 def _build_lib() -> str:
     if (not os.path.exists(_LIB)
             or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if os.path.exists(_LIB) and shutil.which("g++") is None:
+            # A prebuilt library with a stale mtime (e.g. a fresh checkout
+            # touching the source) is still usable when no toolchain exists
+            # to rebuild it; warn rather than crash mid-run.
+            warnings.warn(
+                f"{_LIB} is older than {_SRC} and g++ is unavailable; "
+                "using the stale prebuilt library", stacklevel=2)
+            return _LIB
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
              "-o", _LIB + ".tmp"],

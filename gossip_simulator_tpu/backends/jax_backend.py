@@ -140,10 +140,18 @@ class JaxStepper(Stepper):
                 f"checkpoint was written by the {ckpt_engine} engine but "
                 f"this run resolves to {cfg.engine_resolved}; pass "
                 f"-engine {ckpt_engine} to restore it")
+        if ckpt_engine == "event" and "received" in tree:
+            # Pre-packed-flags event snapshot: fold the two bool arrays into
+            # the uint8 flags layout (bit0 received, bit1 crashed).
+            tree = dict(tree)
+            tree["flags"] = (
+                tree.pop("received").astype(np.uint8)
+                + tree.pop("crashed").astype(np.uint8) * 2)
         # Geometry check: ring layouts are decoded from cfg-derived constants
         # (cap, dw, delay depth), so a snapshot written under different
         # -n/-delayhigh/-event-* flags would silently mis-index.
-        n = int(tree["received"].shape[0])
+        n = int(tree["flags" if ckpt_engine == "event"
+                     else "received"].shape[0])
         if n != cfg.n:
             raise ValueError(
                 f"checkpoint has n={n} but this run has n={cfg.n}")

@@ -21,7 +21,9 @@ padding), `mail_cnt[dw]` the live counts.  Draining sorts each chunk by
 (id, crash-fired-first, tick_off): a node's entries become one contiguous
 run whose FIRST element answers everything -- did any crash draw fire, and
 (if not) the earliest delivery tick, which seeds the re-broadcast delay
-draw.  Infection dedupe across chunks rides the `received` array.
+draw.  Infection dedupe across chunks rides the packed `flags` array
+(bit0 received, bit1 crashed -- one uint8 per node, so the drain's
+random-access flag traffic is one gather + one scatter per chunk).
 
 RNG parity with the ring engine: drop masks and delay slots are drawn from
 the identical (seed, delivery-tick, op, sender-row) streams, so with
@@ -69,11 +71,18 @@ from gossip_simulator_tpu.utils import rng as _rng
 I32 = jnp.int32
 
 
+# flags bit layout: one uint8 per node instead of separate received/crashed
+# bool arrays -- the drain's random-access traffic on n-sized arrays halves
+# (one gather + one scatter per chunk instead of two of each; on this
+# platform op count, not element count, sets the floor).
+RECEIVED = jnp.uint8(1)
+CRASHED = jnp.uint8(2)
+
+
 class EventState(NamedTuple):
     """SI epidemic state with packed message lists instead of count rings."""
 
-    received: jnp.ndarray  # bool[n]
-    crashed: jnp.ndarray  # bool[n]
+    flags: jnp.ndarray  # uint8[n]: bit0 received, bit1 crashed
     friends: jnp.ndarray  # int32[n, k]
     friend_cnt: jnp.ndarray  # int32[n]
     # Flat (dw * cap + drain_chunk,) packed ring: slot s occupies
@@ -149,8 +158,7 @@ def init_state(cfg: Config, friends: jnp.ndarray,
     n = friends.shape[0]  # local rows: the shard slice under the sharded backend
     z = lambda: jnp.zeros((), I32)
     return EventState(
-        received=jnp.zeros((n,), bool),
-        crashed=jnp.zeros((n,), bool),
+        flags=jnp.zeros((n,), jnp.uint8),
         friends=friends,
         friend_cnt=friend_cnt,
         mail_ids=jnp.zeros(
@@ -230,8 +238,8 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     return mail_ids, new_cnt, dropped + lost
 
 
-def drain_chunk_core(crash_p: float, b: int, n_rows: int, received, crashed,
-                     packed, evalid, entry_pos, ckey):
+def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
+                     evalid, entry_pos, ckey):
     """Crash/infect/dedupe one drained chunk of packed entries (shared by the
     single-device and sharded engines; `n_rows` is the local row count).
 
@@ -239,9 +247,15 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, received, crashed,
     contiguous run whose FIRST element answers whether any per-message crash
     draw fired (keyed by mailbox position -- append order is deterministic --
     like the reference's per-reception draw, simulator.go:112-116) and, if
-    not, its earliest delivery tick.
+    not, its earliest delivery tick.  The sort also turns the flags
+    gather/scatter into ascending-id access (better HBM locality than the
+    raw mailbox order).
 
-    Returns (received, crashed, dm, dr, dc, ids_s, toff_s, newly)."""
+    `flags` packs received (bit0) and crashed (bit1) per node; within a
+    chunk a node's winning entry sets at most one new bit, so the update is
+    a single duplicate-free scatter-add.
+
+    Returns (flags, dm, dr, dc, ids_s, toff_s, newly)."""
     ccap = packed.shape[0]
     packed = jnp.where(evalid, packed, n_rows * b)  # sentinel sorts last
     if crash_p > 0.0:
@@ -260,9 +274,10 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, received, crashed,
         crash_s = jnp.zeros((ccap,), bool)
     valid_s = ids_s < n_rows
     idx = jnp.where(valid_s, ids_s, 0)
-    pre_recv = received[idx]
+    pre = flags[idx]
+    pre_recv = (pre & RECEIVED) > 0
     if crash_p > 0.0:
-        pre_crash = crashed[idx] & valid_s
+        pre_crash = ((pre & CRASHED) > 0) & valid_s
     else:
         pre_crash = jnp.zeros((ccap,), bool)
     counted = valid_s & ~pre_crash
@@ -270,16 +285,16 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, received, crashed,
     prev = jnp.concatenate([jnp.full((1,), -1, I32), ids_s[:-1]])
     first = (ids_s != prev) & valid_s
     dc = jnp.zeros((), I32)
+    newly = first & counted & ~pre_recv & ~crash_s
+    dr = newly.sum(dtype=I32)
+    delta = newly.astype(jnp.uint8) * RECEIVED
     if crash_p > 0.0:
         run_crash = first & crash_s & ~pre_crash
         dc = run_crash.sum(dtype=I32)
-        crashed = crashed.at[jnp.where(run_crash, ids_s, n_rows)].max(
-            True, mode="drop")
-    newly = first & counted & ~pre_recv & ~crash_s
-    dr = newly.sum(dtype=I32)
-    received = received.at[jnp.where(newly, ids_s, n_rows)].max(
-        True, mode="drop")
-    return received, crashed, dm, dr, dc, ids_s, toff_s, newly
+        delta = delta + run_crash.astype(jnp.uint8) * CRASHED
+    flags = flags.at[jnp.where(delta > 0, ids_s, n_rows)].add(
+        delta, mode="drop")
+    return flags, dm, dr, dc, ids_s, toff_s, newly
 
 
 def make_window_step_fn(cfg: Config, n_local: int | None = None):
@@ -292,7 +307,7 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
-        n = st.received.shape[0]
+        n = st.flags.shape[0]
         w = st.tick // b
         slot = w % dw
         m = st.mail_cnt[0, slot]
@@ -300,39 +315,38 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
         ckey = _rng.tick_key(base_key, w, _rng.OP_CRASH)
 
         def body(j, carry):
-            (received, crashed, mail_ids, mail_cnt,
-             dm, dr, dc, dropped) = carry
+            (flags, mail_ids, mail_cnt, dm, dr, dc, dropped) = carry
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
             cap = (mail_ids.shape[0] - ccap) // dw
             packed = jax.lax.dynamic_slice(
                 mail_ids, (slot * cap + off0,), (ccap,))
-            received, crashed, cdm, cdr, cdc, ids_s, toff_s, newly = \
-                drain_chunk_core(crash_p, b, n, received, crashed, packed,
+            flags, cdm, cdr, cdc, ids_s, toff_s, newly = \
+                drain_chunk_core(crash_p, b, n, flags, packed,
                                  evalid, entry_pos, ckey)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
             # Newly infected nodes broadcast at their delivery tick
-            # (simulator.go:120-122).
-            sidx = jnp.nonzero(newly, size=ccap, fill_value=ccap)[0]
-            sids = ids_s.at[sidx].get(mode="fill", fill_value=-1)
-            stoff = toff_s.at[sidx].get(mode="fill", fill_value=0)
+            # (simulator.go:120-122).  No compaction: the `newly` mask feeds
+            # append_messages directly -- senders appear in the same
+            # ascending-id order a nonzero() compaction would produce, so
+            # reservation ranks and the mail layout are bit-identical, minus
+            # the nonzero + two gathers.
             mail_ids, mail_cnt, dropped = append_messages(
-                cfg, mail_ids, mail_cnt, dropped, jnp.maximum(sids, 0),
-                sids >= 0, w * b + stoff, st.friends, st.friend_cnt,
+                cfg, mail_ids, mail_cnt, dropped, jnp.where(newly, ids_s, 0),
+                newly, w * b + toff_s, st.friends, st.friend_cnt,
                 base_key)
-            return (received, crashed, mail_ids, mail_cnt, dm, dr, dc,
-                    dropped)
+            return (flags, mail_ids, mail_cnt, dm, dr, dc, dropped)
 
         z = jnp.zeros((), I32)
-        (received, crashed, mail_ids, mail_cnt, dm, dr, dc,
+        (flags, mail_ids, mail_cnt, dm, dr, dc,
          dropped) = jax.lax.fori_loop(
             0, chunks, body,
-            (st.received, st.crashed, st.mail_ids, st.mail_cnt, z, z, z,
+            (st.flags, st.mail_ids, st.mail_cnt, z, z, z,
              st.mail_dropped))
         mail_cnt = mail_cnt.at[0, slot].set(0)
         return st._replace(
-            received=received, crashed=crashed, mail_ids=mail_ids,
+            flags=flags, mail_ids=mail_ids,
             mail_cnt=mail_cnt, tick=st.tick + b,
             total_message=st.total_message + dm,
             total_received=st.total_received + dr,
@@ -349,7 +363,7 @@ def make_seed_fn(cfg: Config):
     seed's delay/drop draws do not depend on tick-0 window state."""
 
     def seed_fn(st: EventState, base_key: jax.Array) -> EventState:
-        n = st.received.shape[0]
+        n = st.flags.shape[0]
         b = batch_ticks(cfg)
         dw = ring_windows(cfg)
         cap = (st.mail_ids.shape[0] - drain_chunk(cfg, n)) // dw
@@ -357,11 +371,11 @@ def make_seed_fn(cfg: Config):
         kd = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DELAY)
         kp = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DROP)
         sender = jax.random.randint(ks, (), 0, n, dtype=I32)
-        received, total_received = st.received, st.total_received
+        flags, total_received = st.flags, st.total_received
         if not cfg.compat_reference:
             # Reference quirk: the seed itself is never marked received
             # (SURVEY §5.4); we count it unless compat is requested.
-            received = received.at[sender].set(True)
+            flags = flags.at[sender].set(RECEIVED)
             total_received = total_received + 1
         k = st.friends.shape[1]
         sf = st.friends[sender]
@@ -382,7 +396,7 @@ def make_seed_fn(cfg: Config):
             jnp.where(ok, flat, dw * cap)].set(payload)  # trash cell if !ok
         mail_cnt = st.mail_cnt.at[0, wslot].add(jnp.where(ok, k, 0))
         dropped = st.mail_dropped + jnp.where(ok, 0, edge.sum(dtype=I32))
-        return st._replace(received=received, total_received=total_received,
+        return st._replace(flags=flags, total_received=total_received,
                            mail_ids=mail_ids, mail_cnt=mail_cnt,
                            mail_dropped=dropped)
 

@@ -42,7 +42,7 @@ I32 = jnp.int32
 
 def event_state_specs() -> EventState:
     return EventState(
-        received=P(AXIS), crashed=P(AXIS),
+        flags=P(AXIS),
         friends=P(AXIS, None), friend_cnt=P(AXIS),
         mail_ids=P(AXIS), mail_cnt=P(AXIS, None),
         tick=P(), total_message=P(), total_received=P(), total_crashed=P(),
@@ -136,26 +136,26 @@ def make_sharded_event_step(cfg: Config, mesh):
         cap = (st.mail_ids.shape[0] - ccap) // dw
 
         def body(j, carry):
-            (received, crashed, mail, cnt, dm, dr, dc, dropped, xovf) = carry
+            (flags, mail, cnt, dm, dr, dc, dropped, xovf) = carry
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
             packed = jax.lax.dynamic_slice(mail, (slot * cap + off0,),
                                            (ccap,))
-            received, crashed, cdm, cdr, cdc, ids_s, toff_s, newly = \
-                event.drain_chunk_core(crash_p, b, n_local, received,
-                                       crashed, packed, evalid, entry_pos,
+            flags, cdm, cdr, cdc, ids_s, toff_s, newly = \
+                event.drain_chunk_core(crash_p, b, n_local, flags,
+                                       packed, evalid, entry_pos,
                                        ckey)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
             # Newly infected (local rows) broadcast at their delivery tick;
             # delay/drop keys are shard-folded + local-row-keyed, the same
-            # scheme the sharded ring engine uses.
-            sel = jnp.nonzero(newly, size=ccap, fill_value=ccap)[0]
-            sids = ids_s.at[sel].get(mode="fill", fill_value=-1)
-            stoff = toff_s.at[sel].get(mode="fill", fill_value=0)
-            svalid = sids >= 0
+            # scheme the sharded ring engine uses.  No compaction (see the
+            # single-device step): `newly` masks ids_s directly, with
+            # identical reservation order.
+            svalid = newly
+            sids = ids_s
             rows = jnp.where(svalid, sids, n_local)
-            sticks = w * b + stoff
+            sticks = w * b + toff_s
             sidx = jnp.where(svalid, sids, 0)
             sf = st.friends.at[sidx].get()
             scnt2 = jnp.where(svalid, st.friend_cnt[sidx], 0)
@@ -183,19 +183,19 @@ def make_sharded_event_step(cfg: Config, mesh):
                 jnp.broadcast_to(wslot2[:, None], (ccap, kwidth)).reshape(-1),
                 jnp.broadcast_to(off2[:, None], (ccap, kwidth)).reshape(-1),
                 edge.reshape(-1), rcap)
-            return (received, crashed, mail, cnt, dm, dr, dc, dropped, xovf)
+            return (flags, mail, cnt, dm, dr, dc, dropped, xovf)
 
         z = jnp.zeros((), I32)
-        (received, crashed, mail, cnt, dm, dr, dc, ddrop,
+        (flags, mail, cnt, dm, dr, dc, ddrop,
          dxovf) = jax.lax.fori_loop(
             0, chunks, body,
-            (st.received, st.crashed, st.mail_ids, st.mail_cnt, z, z, z, z,
+            (st.flags, st.mail_ids, st.mail_cnt, z, z, z, z,
              z))
         cnt = cnt.at[0, slot].set(0)
         dm, dr, dc, ddrop, dxovf = jax.lax.psum((dm, dr, dc, ddrop, dxovf),
                                                 AXIS)
         return st._replace(
-            received=received, crashed=crashed, mail_ids=mail, mail_cnt=cnt,
+            flags=flags, mail_ids=mail, mail_cnt=cnt,
             tick=st.tick + b,
             total_message=st.total_message + dm,
             total_received=st.total_received + dr,
@@ -234,10 +234,11 @@ def make_sharded_event_seed(cfg: Config, mesh):
         arrive = st.tick + delay
         edge = (jnp.arange(kwidth, dtype=I32) < scnt) & ~drop & (sf >= 0) \
             & own
-        received, total_received = st.received, st.total_received
+        flags, total_received = st.flags, st.total_received
         if not cfg.compat_reference:
-            received = received | (
-                (jnp.arange(n_local, dtype=I32) == srow) & own)
+            flags = flags | jnp.where(
+                (jnp.arange(n_local, dtype=I32) == srow) & own,
+                event.RECEIVED, jnp.uint8(0))
             total_received = total_received + 1  # replicated
         # The seed emits at most kwidth messages total; a wave-sized route
         # buffer here would allocate epidemic_cap (~GBs at 1e8) for nothing.
@@ -248,7 +249,7 @@ def make_sharded_event_seed(cfg: Config, mesh):
             jnp.broadcast_to((arrive // b) % dw, (kwidth,)),
             jnp.broadcast_to(arrive % b, (kwidth,)), edge, rcap)
         dropped, xovf = jax.lax.psum((dropped, xovf), AXIS)
-        return st._replace(received=received, total_received=total_received,
+        return st._replace(flags=flags, total_received=total_received,
                            mail_ids=mail, mail_cnt=cnt,
                            mail_dropped=st.mail_dropped + dropped,
                            exchange_overflow=st.exchange_overflow + xovf)

@@ -1,0 +1,43 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from gossip_simulator_tpu.utils import jaxsetup
+jaxsetup.setup()
+import jax, jax.numpy as jnp
+
+n = 10_000_000
+key = jax.random.PRNGKey(0)
+received = jnp.zeros((n,), bool).at[::7].set(True)
+friends = jax.random.randint(key, (n, 3), 0, n, dtype=jnp.int32)
+
+def marginal(fn, r1=4, r2=16):
+    int(fn(r1)); int(fn(r2))  # warm (one compile: reps is dynamic)
+    t0 = time.perf_counter(); int(fn(r1)); t1 = time.perf_counter() - t0
+    t0 = time.perf_counter(); int(fn(r2)); t2 = time.perf_counter() - t0
+    return (t2 - t1) / (r2 - r1)
+
+for ccap in (524288, 2097152, 8388608):
+    ids = jax.random.randint(key, (ccap,), 0, n, dtype=jnp.int32)
+    @jax.jit
+    def g_bool(reps):
+        def body(j, acc):
+            return acc + received[(ids + j) % n].sum(dtype=jnp.int32)
+        return jax.lax.fori_loop(0, reps, body, jnp.zeros((), jnp.int32))
+    @jax.jit
+    def g_friends(reps):
+        def body(j, acc):
+            return acc + friends[(ids + j) % n].sum(dtype=jnp.int32)
+        return jax.lax.fori_loop(0, reps, body, jnp.zeros((), jnp.int32))
+    @jax.jit
+    def srt(reps):
+        def body(j, acc):
+            s, t2 = jax.lax.sort(((ids + j) % n, ids % 10), num_keys=2)
+            return acc + s[0] + t2[-1]
+        return jax.lax.fori_loop(0, reps, body, jnp.zeros((), jnp.int32))
+    @jax.jit
+    def scat(reps):
+        def body(j, r):
+            return r.at[(ids + j) % n].max(True, mode="drop")
+        return jax.lax.fori_loop(0, reps, body, received).sum(dtype=jnp.int32)
+    g = marginal(g_bool); gf = marginal(g_friends)
+    s = marginal(srt); sc = marginal(scat)
+    print(f"ccap={ccap:8d}: gather-bool={g*1e3:7.2f}  gather-friends3={gf*1e3:7.2f}  sort2key={s*1e3:7.2f}  scatter-max={sc*1e3:7.2f}  ms/op", flush=True)

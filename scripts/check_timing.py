@@ -1,0 +1,39 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from gossip_simulator_tpu.utils import jaxsetup
+jaxsetup.setup()
+import jax, jax.numpy as jnp
+import numpy as np
+
+n, ccap = 10_000_000, 524288
+key = jax.random.PRNGKey(0)
+ids = jax.random.randint(key, (ccap,), 0, n, dtype=jnp.int32)
+received = jnp.zeros((n,), bool).at[::7].set(True)
+
+@jax.jit
+def loop_gather(received, ids, reps):
+    def body(j, acc):
+        return acc + received[(ids + j) % n].sum(dtype=jnp.int32)
+    return jax.lax.fori_loop(0, reps, body, jnp.zeros((), jnp.int32))
+
+for reps in (1, 10, 100):
+    r = int(loop_gather(received, ids, reps))  # warm + host fetch
+    t0 = time.perf_counter()
+    r = int(loop_gather(received, ids, reps))
+    t = time.perf_counter() - t0
+    print(f"reps={reps:4d} total={t*1e3:8.2f} ms  per-gather={t/reps*1e3:8.3f} ms  (sum={r})")
+
+# sort comparison inside loop
+@jax.jit
+def loop_sort(ids, reps):
+    def body(j, acc):
+        s, t2 = jax.lax.sort((ids + j, ids % 10), num_keys=2)
+        return acc + s[0] + t2[-1]
+    return jax.lax.fori_loop(0, reps, body, jnp.zeros((), jnp.int32))
+
+for reps in (1, 10, 50):
+    r = int(loop_sort(ids, reps))
+    t0 = time.perf_counter()
+    r = int(loop_sort(ids, reps))
+    t = time.perf_counter() - t0
+    print(f"sort reps={reps:4d} total={t*1e3:8.2f} ms  per-sort={t/reps*1e3:8.3f} ms")

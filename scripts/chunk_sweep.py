@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Sweep the event-engine drain chunk size at the headline bench config.
+
+Per-op overhead, not element count, dominates chunk cost on this platform,
+so fewer/larger chunks should win until ops stop being overhead-bound.
+Prints rate per chunk size; run on the TPU.
+
+Usage: python scripts/chunk_sweep.py [--n 10000000] [--chunks 524288,2097152]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_simulator_tpu.utils import jaxsetup
+
+jaxsetup.setup()
+
+import jax  # noqa: E402
+
+from gossip_simulator_tpu.backends.jax_backend import JaxStepper  # noqa: E402
+from gossip_simulator_tpu.config import Config  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000_000)
+    ap.add_argument("--chunks", type=str,
+                    default="524288,1048576,2097152,4194304")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    for chunk in [int(c) for c in args.chunks.split(",")]:
+        cfg = Config(n=args.n, fanout=3, graph="kout", backend="jax",
+                     seed=args.seed, crashrate=0.001, coverage_target=0.90,
+                     max_rounds=3000, progress=False, pallas=on_tpu,
+                     event_chunk=chunk).validate()
+        s = JaxStepper(cfg)
+        t0 = time.perf_counter()
+        s.init()
+        jax.block_until_ready(s.state.friends)
+        graph_s = time.perf_counter() - t0
+        s.seed()
+        s.run_to_target()  # warm-up: compile + full run
+        s.reset_state()
+        s.seed()
+        t0 = time.perf_counter()
+        stats = s.run_to_target()
+        run_s = time.perf_counter() - t0
+        rate = cfg.n * stats.round / run_s if run_s else 0.0
+        print(f"chunk={chunk:8d}: run={run_s:6.2f}s ticks={stats.round} "
+              f"rate={rate/1e6:7.1f} M node-updates/s "
+              f"cov={stats.total_received/cfg.n:.4f} graph={graph_s:.1f}s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
