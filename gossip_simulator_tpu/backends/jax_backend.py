@@ -127,7 +127,16 @@ class JaxStepper(Stepper):
     def state_pytree(self):
         if self.state is None:
             return None
-        return {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        tree = {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        if "mail_ids" in tree:
+            # Record the mail-ring geometry so a future build whose AUTO
+            # slot-cap/chunk sizing differs can repack instead of rejecting
+            # the snapshot (see load_state_pytree).
+            cfg, n = self.cfg, self.cfg.n
+            tree["mail_geom"] = np.asarray(
+                [event.slot_cap(cfg, n), event.drain_chunk(cfg, n)],
+                dtype=np.int64)
+        return tree
 
     def load_state_pytree(self, tree) -> None:
         from gossip_simulator_tpu.models.event import EventState
@@ -157,17 +166,45 @@ class JaxStepper(Stepper):
                 f"checkpoint has n={n} but this run has n={cfg.n}")
         if ckpt_engine == "event":
             dw = event.ring_windows(cfg)
-            want_mail = (dw * event.slot_cap(cfg, n)
-                         + event.drain_chunk(cfg, n),)
-            if (tuple(tree["mail_ids"].shape) != want_mail
-                    or tuple(tree["mail_cnt"].shape) != (1, dw)):
+            ncap = event.slot_cap(cfg, n)
+            nchunk = event.drain_chunk(cfg, n)
+            want_mail = (dw * ncap + nchunk,)
+            tree = dict(tree)
+            geom = tree.pop("mail_geom", None)
+            if tuple(tree["mail_cnt"].shape) != (1, dw):
                 raise ValueError(
-                    "checkpoint mail-ring geometry "
-                    f"{tuple(tree['mail_ids'].shape)}/"
+                    "checkpoint window-ring depth "
                     f"{tuple(tree['mail_cnt'].shape)} does not match this "
-                    f"config's {want_mail}/(1, {dw}); restore with the "
-                    "same -delaylow/-delayhigh/-event-slot-cap/-event-chunk "
-                    "the snapshot was written with")
+                    f"config's (1, {dw}); restore with the snapshot's "
+                    "-delaylow/-delayhigh")
+            if tuple(tree["mail_ids"].shape) != want_mail:
+                # Geometry drifted (different -event-* flags, or a build
+                # whose auto sizing changed).  Repack slot-by-slot using the
+                # stored geometry; legacy snapshots without mail_geom can't
+                # be repacked safely, so keep the strict error there.
+                if geom is None:
+                    raise ValueError(
+                        "checkpoint mail-ring geometry "
+                        f"{tuple(tree['mail_ids'].shape)} does not match "
+                        f"this config's {want_mail} and the snapshot "
+                        "predates geometry metadata; restore with the same "
+                        "-delaylow/-delayhigh/-event-slot-cap/-event-chunk "
+                        "it was written with")
+                ocap = int(geom[0])
+                old = np.asarray(tree["mail_ids"])
+                cnt = np.asarray(tree["mail_cnt"])[0]
+                new = np.zeros(want_mail, old.dtype)
+                lost = 0
+                for s in range(dw):
+                    take = min(int(cnt[s]), ncap)
+                    lost += int(cnt[s]) - take
+                    new[s * ncap:s * ncap + take] = \
+                        old[s * ocap:s * ocap + take]
+                tree["mail_ids"] = new
+                tree["mail_cnt"] = np.minimum(
+                    np.asarray(tree["mail_cnt"]), ncap)
+                tree["mail_dropped"] = np.asarray(
+                    tree["mail_dropped"]) + np.int32(lost)
         else:
             d = epidemic.ring_depth(cfg)
             if tuple(tree["pending"].shape) != (d, n):

@@ -150,10 +150,12 @@ class Config:
 
     @property
     def engine_resolved(self) -> str:
-        """Event engine requires SI + ticks semantics on the jax or sharded
-        backend; everything else uses the ring engine.  An explicit
-        `-compact on/off` is a ring-engine request (the event engine has no
-        dense path to compact), so auto honors it."""
+        """Event engine requires SI/SIR + ticks semantics on the jax or
+        sharded backend; everything else uses the ring engine.  Auto picks
+        event only for SI (SIR stays on the proven ring path unless
+        `-engine event` asks for it).  An explicit `-compact on/off` is a
+        ring-engine request (the event engine has no dense path to
+        compact), so auto honors it."""
         if self.engine == "event":
             return "event"
         if (self.engine == "auto" and self.backend in ("jax", "sharded")
@@ -208,12 +210,17 @@ class Config:
         if self.engine not in ENGINES:
             raise ValueError(f"engine must be one of {ENGINES}, got {self.engine!r}")
         if self.engine == "event":
-            if self.protocol != "si" or self.effective_time_mode != "ticks":
+            if (self.protocol not in ("si", "sir")
+                    or self.effective_time_mode != "ticks"):
                 raise ValueError(
-                    "engine=event supports protocol=si in ticks mode only")
+                    "engine=event supports protocol=si|sir in ticks mode only")
             if self.backend not in ("jax", "sharded"):
                 raise ValueError(
                     "engine=event requires backend=jax or sharded")
+            if self.protocol == "sir" and self.backend != "jax":
+                raise ValueError(
+                    "engine=event with protocol=sir runs on backend=jax "
+                    "(the sharded event engine is SI-only)")
         if self.time_mode not in TIME_MODES:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"

@@ -343,7 +343,11 @@ def make_seed_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
         sender = jax.random.randint(ks, (), 0, n, dtype=I32)
         is_sender = jnp.arange(n, dtype=I32) == sender
         received, total_received = st.received, st.total_received
-        if cfg.protocol == "pushpull" or not cfg.compat_reference:
+        if cfg.protocol != "si" or not cfg.compat_reference:
+            # The seed-never-received quirk (SURVEY §5.4) is an SI compat
+            # surface only: pushpull/SIR have no referent in the reference,
+            # and the event engine needs the received bit for trigger
+            # firing, so both engines mark+count the seed there.
             received = received | is_sender
             total_received = total_received + 1
         if cfg.protocol == "pushpull":

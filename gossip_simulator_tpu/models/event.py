@@ -42,6 +42,10 @@ crash-path only:
   chunk boundary re-broadcasts from its first-ENCOUNTERED delivery tick
   rather than its globally earliest one (dedupe itself stays exact via the
   received array).
+* SIR only: a re-broadcast trigger firing in the same CHUNK as a data
+  reception whose crash draw fires still fires (trigger eligibility reads
+  chunk-start state); the ring engine's same-tick `due & ~crashed` blocks
+  it.  Margin ~crashrate x (trigger co-arrival rate), crash-path only.
 
 Control-flow note: built strictly from constructs proven on the axon TPU
 platform -- outer fori windows, inner dynamic-trip fori chunks, gathers,
@@ -77,6 +81,7 @@ I32 = jnp.int32
 # platform op count, not element count, sets the floor).
 RECEIVED = jnp.uint8(1)
 CRASHED = jnp.uint8(2)
+REMOVED = jnp.uint8(4)  # SIR: stopped re-broadcasting (still counts coverage)
 
 
 class EventState(NamedTuple):
@@ -110,12 +115,23 @@ class EventState(NamedTuple):
 
 def batch_ticks(cfg: Config, n_local: int | None = None) -> int:
     """Window size B: delays >= delaylow >= B guarantee no intra-window
-    causality.  Also bounded so the packed id*B+tick_off fits int32."""
+    causality.  Also bounded so the packed id*B+tick_off fits int32 --
+    SIR additionally packs re-broadcast triggers at (n+1+id)*B+off
+    (see trigger_base), doubling the range."""
     n = n_local if n_local is not None else cfg.n
     b = max(1, min(10, cfg.delaylow))
-    while b > 1 and (n + 1) * b >= 2**31:
+    span = 2 * n + 3 if cfg.protocol == "sir" else n + 1
+    while b > 1 and span * b >= 2**31:
         b //= 2
     return b
+
+
+def trigger_base(n: int, b: int) -> int:
+    """SIR re-broadcast triggers are tagged self-messages packed as
+    trigger_base + id*b + off: they sort after every data entry (and after
+    the data padding sentinel n*b), so a node's data run stays contiguous
+    and triggers form their own runs."""
+    return (n + 1) * b
 
 
 def ring_windows(cfg: Config, n_local: int | None = None) -> int:
@@ -132,11 +148,15 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
     n = n_local if n_local is not None else cfg.n
     b = batch_ticks(cfg, n_local)
     dw = ring_windows(cfg, n_local)
+    # SIR reserves one extra slot per sender for its re-broadcast trigger.
+    deg = cfg.max_degree + (1 if cfg.protocol == "sir" else 0)
     cap = cfg.event_slot_cap if cfg.event_slot_cap > 0 else max(
-        4096, int(math.ceil(1.5 * n * cfg.max_degree * b
+        4096, int(math.ceil(1.5 * n * deg * b
                             / max(cfg.delay_span, 1))))
-    # One slot can never hold more than every SI message plus padding.
-    cap = min(cap, n * cfg.max_degree + cfg.max_degree)
+    # One slot can never hold more than every SI message plus padding
+    # (SIR re-broadcasts indefinitely, so the bound only applies to SI).
+    if cfg.protocol != "sir":
+        cap = min(cap, n * cfg.max_degree + cfg.max_degree)
     if cfg.event_slot_cap <= 0:
         # Auto sizing also respects HBM: bound the whole ring to ~3 GB
         # (validated headroom for the 100M single-chip run on a 16 GB v5e;
@@ -147,9 +167,17 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
 
 
 def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
-    """Drain chunk size: large, because per-op dispatch overhead (not element
-    count) dominates chunk cost on this platform."""
-    want = cfg.event_chunk if cfg.event_chunk > 0 else 524_288
+    """Drain chunk size: auto = clamp(n/64, 128k, 512k).
+
+    Swept empirically on v5e.  n=1e7: 64k:752, 128k:769, 256k:718,
+    512k:623, 1M:487 M node-updates/s -- op cost grows superlinearly past
+    ~128k entries (sort passes, scatter contention), favoring small chunks.
+    n=1e8: 128k:303, 256k:782, 512k:903, 1M:880 -- the n-sized flag
+    gather/scatter per chunk grows with n, so fewer/larger chunks win.  The
+    n/64 ramp hits both optima."""
+    n = n_local if n_local is not None else cfg.n
+    want = cfg.event_chunk if cfg.event_chunk > 0 else \
+        min(524_288, max(131_072, n // 64))
     return min(slot_cap(cfg, n_local), max(256, want))
 
 
@@ -181,7 +209,8 @@ def _sender_keys(base_key, op: int, ticks, rows):
 
 
 def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
-                    svalid, sticks, friends, friend_cnt, base_key):
+                    svalid, sticks, friends, friend_cnt, base_key,
+                    strig=None):
     """Emit each sender's broadcast (k sends, ONE shared delay drawn at its
     delivery tick -- simulator.go:141-142) into the packed mail ring.
 
@@ -189,11 +218,18 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     each sender reserves k contiguous positions there (rank via a
     (senders, dw) one-hot cumsum), dropped/invalid edges are written as the
     sentinel id so reservations stay contiguous, and the write is one flat
-    1-D mode="drop" scatter."""
+    1-D mode="drop" scatter.
+
+    SIR (`strig` mask set): senders also schedule their next re-broadcast as
+    a tagged self-message (trigger_base + id*b + off) arriving with the SAME
+    shared delay -- the event analog of the ring engine's
+    `rebroadcast.at[dslot, ids]` (models/epidemic.py tick_core); reservations
+    widen to k+1."""
     n, k = friends.shape
     dw = ring_windows(cfg)
     cap = (mail_ids.shape[0] - drain_chunk(cfg, n)) // dw
     b = batch_ticks(cfg)
+    kk_res = k if strig is None else k + 1  # reservation width per sender
     rows = jnp.where(svalid, sender_ids, n)
     sidx = jnp.where(svalid, sender_ids, 0)
     sf = friends.at[sidx].get()
@@ -223,23 +259,29 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
         jnp.cumsum(oh, axis=0), jnp.where(svalid, wslot, 0)[:, None],
         axis=1)[:, 0] - 1
     base = mail_cnt[0, jnp.where(svalid, wslot, 0)]
-    start = base + srank * k
-    ok = svalid & (start + k <= cap)
+    start = base + srank * kk_res
+    ok = svalid & (start + kk_res <= cap)
     flat = (jnp.where(ok, wslot, 0)[:, None] * cap + start[:, None]
-            + jnp.arange(k, dtype=I32)[None, :])
+            + jnp.arange(kk_res, dtype=I32)[None, :])
     flat = jnp.where(ok[:, None], flat, dw * cap)  # -> in-bounds trash cell
     payload = jnp.where(edge, sf * b + off[:, None], n * b)
+    if strig is not None:
+        tb = trigger_base(n, b)
+        tcol = jnp.where(strig, tb + sender_ids * b + off, n * b)
+        payload = jnp.concatenate([payload, tcol[:, None]], axis=1)
     mail_ids = mail_ids.at[flat.reshape(-1)].set(payload.reshape(-1))
     # Overflowed senders are a per-slot suffix (start grows with rank), so
     # counting only written reservations keeps positions contiguous.
-    adds = (oh * ok[:, None]).sum(axis=0) * k
+    adds = (oh * ok[:, None]).sum(axis=0) * kk_res
     new_cnt = mail_cnt + adds[None, :]
     lost = (edge & ~ok[:, None]).sum(dtype=I32)  # real edges, not padding
+    if strig is not None:
+        lost = lost + (strig & ~ok).sum(dtype=I32)
     return mail_ids, new_cnt, dropped + lost
 
 
 def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
-                     evalid, entry_pos, ckey):
+                     evalid, entry_pos, ckey, sir: bool = False):
     """Crash/infect/dedupe one drained chunk of packed entries (shared by the
     single-device and sharded engines; `n_rows` is the local row count).
 
@@ -255,35 +297,57 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     chunk a node's winning entry sets at most one new bit, so the update is
     a single duplicate-free scatter-add.
 
-    Returns (flags, dm, dr, dc, ids_s, toff_s, newly)."""
+    With `sir` (static -- compiles to the identical SI program when False):
+    trigger entries (trigger_base + id*b + off) sort after all data into
+    their own per-node runs.  Data entries infect exactly as in SI; a
+    trigger FIRES -- the node re-broadcasts at its tick -- iff the node is
+    infected and neither crashed nor removed as of the chunk start (the
+    ring engine's `due & ~crashed & ~removed`; same-chunk crash-vs-trigger
+    ordering divergence is documented in the module docstring).  Crash
+    draws fire on data receptions only; removal draws happen in the caller
+    (per sender, at send time, matching tick_core's removal-after-send).
+
+    Returns (flags, dm, dr, dc, ids_s, toff_s, senders); senders is
+    newly-infected for SI, newly | firing for SIR (disjoint: a trigger
+    implies the node was already infected)."""
     ccap = packed.shape[0]
-    packed = jnp.where(evalid, packed, n_rows * b)  # sentinel sorts last
+    tb = trigger_base(n_rows, b)
+    sentinel = tb + n_rows * b if sir else n_rows * b
+    packed = jnp.where(evalid, packed, sentinel)  # sentinel sorts last
     if crash_p > 0.0:
         ck = _rng.row_keys(ckey, entry_pos)
         draw = jax.vmap(lambda kk: jax.random.bernoulli(kk, crash_p))(ck)
         crash_e = draw & evalid
+        if sir:
+            crash_e = crash_e & (packed < n_rows * b)  # not on triggers
         sub = (1 - crash_e.astype(I32)) * b + packed % b
-        packed_s, sub_s = jax.lax.sort((packed // b * b, sub), num_keys=2)
-        ids_s = packed_s // b
+        key1_s, sub_s = jax.lax.sort((packed // b * b, sub), num_keys=2)
         toff_s = sub_s % b
         crash_s = sub_s < b
     else:
         packed_s = jnp.sort(packed)
-        ids_s = packed_s // b
+        key1_s = packed_s // b * b
         toff_s = packed_s % b
         crash_s = jnp.zeros((ccap,), bool)
-    valid_s = ids_s < n_rows
-    idx = jnp.where(valid_s, ids_s, 0)
+    is_data = key1_s < n_rows * b
+    if sir:
+        is_trig = (key1_s >= tb) & (key1_s < sentinel)
+        ids_s = jnp.where(is_trig, (key1_s - tb) // b, key1_s // b)
+        touched = is_data | is_trig
+    else:
+        ids_s = key1_s // b
+        touched = is_data
+    idx = jnp.where(touched, ids_s, 0)
     pre = flags[idx]
     pre_recv = (pre & RECEIVED) > 0
     if crash_p > 0.0:
-        pre_crash = ((pre & CRASHED) > 0) & valid_s
+        pre_crash = ((pre & CRASHED) > 0) & touched
     else:
         pre_crash = jnp.zeros((ccap,), bool)
-    counted = valid_s & ~pre_crash
+    counted = is_data & ~pre_crash
     dm = counted.sum(dtype=I32)
-    prev = jnp.concatenate([jnp.full((1,), -1, I32), ids_s[:-1]])
-    first = (ids_s != prev) & valid_s
+    prev = jnp.concatenate([jnp.full((1,), -1, I32), key1_s[:-1]])
+    first = (key1_s != prev) & is_data
     dc = jnp.zeros((), I32)
     newly = first & counted & ~pre_recv & ~crash_s
     dr = newly.sum(dtype=I32)
@@ -294,17 +358,24 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
         delta = delta + run_crash.astype(jnp.uint8) * CRASHED
     flags = flags.at[jnp.where(delta > 0, ids_s, n_rows)].add(
         delta, mode="drop")
-    return flags, dm, dr, dc, ids_s, toff_s, newly
+    senders = newly
+    if sir:
+        fire = is_trig & pre_recv & ~pre_crash & ~((pre & REMOVED) > 0)
+        senders = newly | fire
+    return flags, dm, dr, dc, ids_s, toff_s, senders
 
 
 def make_window_step_fn(cfg: Config, n_local: int | None = None):
     """One B-tick window transition: drain this window's packed list in
     chunks (drain_chunk_core), and emit the newly infected nodes' broadcasts
-    at their actual delivery ticks."""
+    at their actual delivery ticks.  SIR adds re-broadcast triggers and
+    per-sender removal draws (drain_chunk_core with sir=True)."""
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
     ccap = drain_chunk(cfg, n_local)
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
+    sir = cfg.protocol == "sir"
+    removal_p = epidemic.p_eff(cfg, cfg.removal_rate) if sir else 0.0
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.flags.shape[0]
@@ -322,20 +393,33 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
             cap = (mail_ids.shape[0] - ccap) // dw
             packed = jax.lax.dynamic_slice(
                 mail_ids, (slot * cap + off0,), (ccap,))
-            flags, cdm, cdr, cdc, ids_s, toff_s, newly = \
-                drain_chunk_core(crash_p, b, n, flags, packed,
-                                 evalid, entry_pos, ckey)
+            flags, cdm, cdr, cdc, ids_s, toff_s, senders = \
+                drain_chunk_core(crash_p, b, n, flags, packed, evalid,
+                                 entry_pos, ckey, sir=sir)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
-            # Newly infected nodes broadcast at their delivery tick
-            # (simulator.go:120-122).  No compaction: the `newly` mask feeds
-            # append_messages directly -- senders appear in the same
-            # ascending-id order a nonzero() compaction would produce, so
-            # reservation ranks and the mail layout are bit-identical, minus
-            # the nonzero + two gathers.
+            sticks = w * b + toff_s
+            strig = None
+            if sir:
+                # Removal draw per sender at its send tick (the ring
+                # engine's removal-after-send, tick_core); removed senders
+                # still broadcast this once but schedule no next trigger.
+                rows = jnp.where(senders, ids_s, n)
+                rk = _sender_keys(base_key, _rng.OP_REMOVE, sticks, rows)
+                rem = jax.vmap(lambda kk: jax.random.bernoulli(
+                    kk, removal_p))(rk) & senders if removal_p > 0.0 \
+                    else jnp.zeros(senders.shape, bool)
+                flags = flags.at[jnp.where(rem, ids_s, n)].add(
+                    REMOVED, mode="drop")
+                strig = senders & ~rem
+            # Senders broadcast at their delivery tick (simulator.go:120-122).
+            # No compaction: the mask feeds append_messages directly --
+            # senders appear in the same ascending-id order a nonzero()
+            # compaction would produce, so reservation ranks and the mail
+            # layout are bit-identical, minus the nonzero + two gathers.
             mail_ids, mail_cnt, dropped = append_messages(
-                cfg, mail_ids, mail_cnt, dropped, jnp.where(newly, ids_s, 0),
-                newly, w * b + toff_s, st.friends, st.friend_cnt,
-                base_key)
+                cfg, mail_ids, mail_cnt, dropped,
+                jnp.where(senders, ids_s, 0), senders, sticks,
+                st.friends, st.friend_cnt, base_key, strig=strig)
             return (flags, mail_ids, mail_cnt, dm, dr, dc, dropped)
 
         z = jnp.zeros((), I32)
@@ -372,9 +456,11 @@ def make_seed_fn(cfg: Config):
         kp = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DROP)
         sender = jax.random.randint(ks, (), 0, n, dtype=I32)
         flags, total_received = st.flags, st.total_received
-        if not cfg.compat_reference:
+        if cfg.protocol == "sir" or not cfg.compat_reference:
             # Reference quirk: the seed itself is never marked received
-            # (SURVEY §5.4); we count it unless compat is requested.
+            # (SURVEY §5.4); we count it unless compat is requested.  SIR
+            # always marks it: trigger firing requires the received bit (the
+            # reference has no SIR, so there is no compat surface to match).
             flags = flags.at[sender].set(RECEIVED)
             total_received = total_received + 1
         k = st.friends.shape[1]
@@ -389,13 +475,26 @@ def make_seed_fn(cfg: Config):
         wslot = (arrive // b) % dw
         edge = (jnp.arange(k, dtype=I32) < scnt) & ~drop & (sf >= 0)
         payload = jnp.where(edge, sf * b + arrive % b, n * b)
+        lost = edge.sum(dtype=I32)
+        if cfg.protocol == "sir":
+            # The seed is a sender like any other: a removal draw decides
+            # whether it schedules a re-broadcast trigger (the ring
+            # engine's SEED_TICK OP_REMOVE draw).
+            kr = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_REMOVE)
+            keep = ~_rng.bernoulli(kr, epidemic.p_eff(cfg, cfg.removal_rate),
+                                   ())
+            tb = trigger_base(n, b)
+            tcol = jnp.where(keep, tb + sender * b + arrive % b, n * b)
+            payload = jnp.concatenate([payload, tcol[None]])
+            lost = lost + keep.astype(I32)  # a dropped trigger counts too
+            k = k + 1
         base = st.mail_cnt[0, wslot]
         flat = wslot * cap + base + jnp.arange(k, dtype=I32)
         ok = base + k <= cap
         mail_ids = st.mail_ids.at[
             jnp.where(ok, flat, dw * cap)].set(payload)  # trash cell if !ok
         mail_cnt = st.mail_cnt.at[0, wslot].add(jnp.where(ok, k, 0))
-        dropped = st.mail_dropped + jnp.where(ok, 0, edge.sum(dtype=I32))
+        dropped = st.mail_dropped + jnp.where(ok, 0, lost)
         return st._replace(flags=flags, total_received=total_received,
                            mail_ids=mail_ids, mail_cnt=mail_cnt,
                            mail_dropped=dropped)

@@ -38,8 +38,15 @@ def test_auto_engine_selection():
     # Explicit compact is a ring-engine request.
     assert Config(**{**BASE, "compact": "on"}).validate() \
         .engine_resolved == "ring"
+    # SIR runs on the event engine only by explicit request, jax backend only.
+    assert Config(**{**BASE, "engine": "event", "protocol": "sir"}) \
+        .validate().engine_resolved == "event"
+    with pytest.raises(ValueError, match="sharded event engine is SI-only"):
+        Config(**{**BASE, "engine": "event", "protocol": "sir",
+                  "backend": "sharded"}).validate()
     with pytest.raises(ValueError, match="engine=event"):
-        Config(**{**BASE, "engine": "event", "protocol": "sir"}).validate()
+        Config(**{**BASE, "engine": "event",
+                  "protocol": "pushpull"}).validate()
 
 
 def test_event_converges_and_matches_ring_trajectory():
@@ -179,6 +186,61 @@ def test_event_sharded_run_to_target_matches_windows():
     assert fast.total_received == res.stats.total_received
 
 
+def test_event_sir_removal_one_matches_si():
+    """removal_rate=1: every sender broadcasts exactly once then stops --
+    the SIR wave degenerates to SI.  Drop/delay streams are row-keyed and
+    identical, so with crashrate=0 the totals match SI exactly."""
+    sir, _ = _run(engine="event", protocol="sir", removal_rate=1.0,
+                  coverage_target=0.9)
+    si, _ = _run(engine="event", protocol="si", coverage_target=0.9)
+    assert sir.stats.total_message == si.stats.total_message
+    assert sir.stats.total_received == si.stats.total_received
+    assert sir.coverage_ms == si.coverage_ms
+
+
+def test_event_sir_rebroadcasts_push_past_si():
+    """At high drop, SI (one broadcast per node) stalls below the target;
+    SIR re-broadcasts until removed and pushes through."""
+    kw = dict(droprate=0.45, coverage_target=0.95, max_rounds=4000)
+    si, _ = _run(engine="event", protocol="si", **kw)
+    sir, _ = _run(engine="event", protocol="sir", removal_rate=0.3, **kw)
+    assert sir.stats.total_message > si.stats.total_message
+    assert sir.stats.total_received >= si.stats.total_received
+    assert sir.converged
+
+
+def test_event_sir_close_to_ring_sir():
+    """Ring and event SIR share physics but differ in removal-stream keying
+    (dense per-tick vs per-sender fold_in) -- totals agree statistically."""
+    kw = dict(protocol="sir", removal_rate=0.25, droprate=0.3,
+              coverage_target=0.9, max_rounds=4000)
+    ev, _ = _run(engine="event", **kw)
+    ri, _ = _run(engine="ring", **kw)
+    assert ev.converged and ri.converged
+    assert abs(ev.stats.total_message - ri.stats.total_message) \
+        / max(ri.stats.total_message, 1) < 0.1
+    assert abs(ev.stats.total_received - ri.stats.total_received) \
+        / max(ri.stats.total_received, 1) < 0.05
+
+
+def test_event_sir_dieout_exhausts():
+    """Aggressive removal + drop can kill the wave below target: the run
+    must end by exhaustion (no in-flight messages, no live triggers), not
+    by walking to max_rounds."""
+    res, _ = _run(engine="event", protocol="sir", removal_rate=1.0,
+                  droprate=0.9, max_rounds=50_000)
+    assert not res.converged
+    assert res.gossip_windows < 100
+
+
+def test_event_sir_determinism():
+    kw = dict(engine="event", protocol="sir", removal_rate=0.25,
+              crashrate=0.01, coverage_target=0.9)
+    r1, _ = _run(**kw)
+    r2, _ = _run(**kw)
+    assert r1.stats == r2.stats
+
+
 def test_event_checkpoint_roundtrip(tmp_path):
     cfg = Config(**BASE).validate()
     s = JaxStepper(cfg)
@@ -193,3 +255,27 @@ def test_event_checkpoint_roundtrip(tmp_path):
     a = s.gossip_window()
     b = s2.gossip_window()
     assert a == b
+
+
+def test_event_checkpoint_repacks_across_chunk_geometry():
+    """A snapshot written under one -event-chunk/-event-slot-cap restores
+    under different auto sizing: the stored mail_geom drives a slot-by-slot
+    repack (a future build changing the auto constants must not strand old
+    snapshots)."""
+    cfg = Config(**{**BASE, "event_chunk": 512}).validate()
+    s = JaxStepper(cfg)
+    s.init()
+    s.seed()
+    s.gossip_window()
+    tree = s.state_pytree()
+    assert "mail_geom" in tree
+    cfg2 = Config(**{**BASE, "event_chunk": 2048}).validate()
+    s2 = JaxStepper(cfg2)
+    s2.init()
+    s2.load_state_pytree(tree)
+    a = s.gossip_window()
+    b = s2.gossip_window()
+    # Same entries in the same slot order; only the chunking (and hence the
+    # crash entry_pos stream -- crashrate is 0 here) differs.
+    assert a.total_received == b.total_received
+    assert a.total_message == b.total_message
