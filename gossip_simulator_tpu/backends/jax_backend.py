@@ -177,7 +177,13 @@ class JaxStepper(Stepper):
                     f"{tuple(tree['mail_cnt'].shape)} does not match this "
                     f"config's (1, {dw}); restore with the snapshot's "
                     "-delaylow/-delayhigh")
-            if tuple(tree["mail_ids"].shape) != want_mail:
+            # Compare the STORED geometry, not just array length: distinct
+            # (cap, chunk) pairs can have equal dw*cap+chunk totals, which
+            # would mis-index every slot base if accepted as-is.
+            drifted = ((int(geom[0]), int(geom[1])) != (ncap, nchunk)
+                       if geom is not None
+                       else tuple(tree["mail_ids"].shape) != want_mail)
+            if drifted:
                 # Geometry drifted (different -event-* flags, or a build
                 # whose auto sizing changed).  Repack slot-by-slot using the
                 # stored geometry; legacy snapshots without mail_geom can't
@@ -190,7 +196,12 @@ class JaxStepper(Stepper):
                         "predates geometry metadata; restore with the same "
                         "-delaylow/-delayhigh/-event-slot-cap/-event-chunk "
                         "it was written with")
-                ocap = int(geom[0])
+                ocap, ochunk = int(geom[0]), int(geom[1])
+                if tree["mail_ids"].shape[0] != dw * ocap + ochunk:
+                    raise ValueError(
+                        f"checkpoint mail_ids length "
+                        f"{tree['mail_ids'].shape[0]} contradicts its "
+                        f"stored geometry (cap={ocap}, chunk={ochunk})")
                 old = np.asarray(tree["mail_ids"])
                 cnt = np.asarray(tree["mail_cnt"])[0]
                 new = np.zeros(want_mail, old.dtype)

@@ -25,6 +25,14 @@ import dataclasses
 import math
 from typing import Optional
 
+
+def er_cap(lam: float) -> int:
+    """Erdős–Rényi friends-column capacity: covers the Poisson(lam) upper
+    tail to ~6 sigma (overflow is clipped in degree, probability ~1e-9 per
+    node at lam <= 32).  The single source of truth for every ER generator
+    (models/graphs.erdos, ops/pallas_graph.erdos_pallas)."""
+    return max(1, int(math.ceil(lam + 6.0 * math.sqrt(max(lam, 1.0)) + 4)))
+
 BACKENDS = ("native", "cpp", "jax", "sharded")
 PROTOCOLS = ("si", "pushpull", "sir")
 GRAPHS = ("overlay", "kout", "erdos", "ring")
@@ -134,6 +142,16 @@ class Config:
     @property
     def er_p_resolved(self) -> float:
         return self.er_p if self.er_p > 0 else self.fanout / max(self.n, 1)
+
+    @property
+    def graph_width(self) -> int:
+        """Actual friends-table column count for this config's graph: the
+        Erdős–Rényi generators pad to the Poisson tail cap (er_cap), which
+        can be ~3x max_degree -- ring sizing (event.slot_cap) must use THIS,
+        not max_degree, or per-sender reservations overflow."""
+        if self.graph == "erdos":
+            return er_cap(self.er_p_resolved * self.n)
+        return self.max_degree
 
     @property
     def effective_time_mode(self) -> str:

@@ -22,8 +22,9 @@ padding), `mail_cnt[dw]` the live counts.  Draining sorts each chunk by
 run whose FIRST element answers everything -- did any crash draw fire, and
 (if not) the earliest delivery tick, which seeds the re-broadcast delay
 draw.  Infection dedupe across chunks rides the packed `flags` array
-(bit0 received, bit1 crashed -- one uint8 per node, so the drain's
-random-access flag traffic is one gather + one scatter per chunk).
+(bit0 received, bit1 crashed, bit2 removed under SIR -- one uint8 per
+node, so the drain's random-access flag traffic is one gather + one
+scatter per chunk).
 
 RNG parity with the ring engine: drop masks and delay slots are drawn from
 the identical (seed, delivery-tick, op, sender-row) streams, so with
@@ -87,7 +88,7 @@ REMOVED = jnp.uint8(4)  # SIR: stopped re-broadcasting (still counts coverage)
 class EventState(NamedTuple):
     """SI epidemic state with packed message lists instead of count rings."""
 
-    flags: jnp.ndarray  # uint8[n]: bit0 received, bit1 crashed
+    flags: jnp.ndarray  # uint8[n]: bit0 received, bit1 crashed, bit2 removed (SIR)
     friends: jnp.ndarray  # int32[n, k]
     friend_cnt: jnp.ndarray  # int32[n]
     # Flat (dw * cap + drain_chunk,) packed ring: slot s occupies
@@ -148,15 +149,17 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
     n = n_local if n_local is not None else cfg.n
     b = batch_ticks(cfg, n_local)
     dw = ring_windows(cfg, n_local)
-    # SIR reserves one extra slot per sender for its re-broadcast trigger.
-    deg = cfg.max_degree + (1 if cfg.protocol == "sir" else 0)
+    # Per-sender reservation width: the actual friends-table column count
+    # (graph_width -- erdos pads to the Poisson tail cap, ~3x max_degree),
+    # plus one for SIR's re-broadcast trigger.
+    deg = cfg.graph_width + (1 if cfg.protocol == "sir" else 0)
     cap = cfg.event_slot_cap if cfg.event_slot_cap > 0 else max(
         4096, int(math.ceil(1.5 * n * deg * b
                             / max(cfg.delay_span, 1))))
     # One slot can never hold more than every SI message plus padding
     # (SIR re-broadcasts indefinitely, so the bound only applies to SI).
     if cfg.protocol != "sir":
-        cap = min(cap, n * cfg.max_degree + cfg.max_degree)
+        cap = min(cap, n * cfg.graph_width + cfg.graph_width)
     if cfg.event_slot_cap <= 0:
         # Auto sizing also respects HBM: bound the whole ring to ~3 GB
         # (validated headroom for the 100M single-chip run on a 16 GB v5e;

@@ -10,11 +10,11 @@ the sharded backend can build its slice without materializing the full graph.
 
 from __future__ import annotations
 
-import math
 
 import jax
 import jax.numpy as jnp
 
+from gossip_simulator_tpu import config as config_mod
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.utils import rng as _rng
 
@@ -74,7 +74,27 @@ def erdos(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None):
     n = cfg.n
     rows = n if rows is None else rows
     lam = cfg.er_p_resolved * n
-    cap = max(1, int(math.ceil(lam + 6.0 * math.sqrt(max(lam, 1.0)) + 4)))
+    cap = config_mod.er_cap(lam)
+    if cfg.pallas and isinstance(row0, int):
+        # Same routing contract as kout: real TPU only (the interpreter's
+        # PRNG is a zero stub), static block-aligned row offset, and the
+        # kernel's own lam/cap limits (f32 pmf recurrence, 128-lane tile).
+        from gossip_simulator_tpu.ops.pallas_graph import (
+            BLOCK_ROWS, LANES, erdos_pallas)
+
+        if (0.0 < lam <= 60.0 and cap <= LANES
+                and row0 % BLOCK_ROWS == 0
+                and jax.default_backend() == "tpu"):
+            return erdos_pallas(n, float(lam), row0, rows, cfg.seed,
+                                interpret=False)
+    if cfg.pallas:
+        import warnings
+
+        warnings.warn(
+            "-pallas requested but the Pallas erdos generator is "
+            "unavailable here (needs a real TPU backend, lam <= 60, "
+            "cap <= 128 lanes, block-aligned static row offset); using the "
+            "fold_in generator instead", stacklevel=2)
     keys = _row_keys(key, row0, rows)
 
     def one_row(rk):

@@ -55,6 +55,80 @@ def _kout_kernel(n: int, k: int, row0: int, seed_ref, out_ref):
     out_ref[:] = jnp.where(peers == gid, (peers + 1) % n, peers)
 
 
+_ER_STREAM = 0x4552D14D  # XOR'd into the seed: decorrelates ER from kout
+
+
+def _erdos_kernel(n: int, lam: float, cap: int, row0: int, seed_ref,
+                  out_ref):
+    blk = pl.program_id(0)
+    # The platform caps prng_seed at 2 values, so the stream tag folds into
+    # the seed word instead of riding as a third argument.
+    pltpu.prng_seed(seed_ref[0] ^ _ER_STREAM, row0 // BLOCK_ROWS + blk)
+    bits = pltpu.prng_random_bits((cap + 1, BLOCK_ROWS))
+    # Row 0 -> the Poisson uniform; rows 1.. -> peer picks.  The top 24 bits
+    # shift into int32 range first (Mosaic has no uint32->f32 cast).
+    u = (bits[0:1].astype(jnp.uint32) >> jnp.uint32(8)).astype(
+        jnp.int32).astype(jnp.float32) * (2.0 ** -24)
+
+    # Degree ~ Poisson(lam) by inverse CDF: X = #{j : u > P(X <= j)}.  The
+    # pmf recurrence runs in f32 scalars; exp(-lam) stays normal for
+    # lam <= 60 (the wrapper rejects larger).
+    def body(j, carry):
+        pmf, cdf, deg = carry
+        cdf = cdf + pmf
+        deg = deg + (u > cdf).astype(jnp.int32)
+        pmf = pmf * (jnp.float32(lam) / (j + 1).astype(jnp.float32))
+        return pmf, cdf, deg
+
+    import math as _math
+
+    _, _, deg = jax.lax.fori_loop(
+        0, cap, body,
+        (jnp.float32(_math.exp(-lam)), jnp.float32(0.0),
+         jnp.zeros((1, BLOCK_ROWS), jnp.int32)))
+    peers = (bits[1:].astype(jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
+    gid = (row0 + blk * BLOCK_ROWS
+           + jax.lax.broadcasted_iota(jnp.int32, (cap, BLOCK_ROWS), 1))
+    peers = jnp.where(peers == gid, (peers + 1) % n, peers)
+    out_ref[:] = jnp.concatenate([deg, peers], axis=0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 5))
+def erdos_pallas(n: int, lam: float, row0: int, rows: int, seed,
+                 interpret: bool = False):
+    """Sparse directed Erdos-Renyi slice via the TPU PRNG: out-degree ~
+    Poisson(lam = n*p) like models/graphs.erdos (different, equally random
+    stream -- same contract as kout_pallas), peers uniform with the (id+1)%n
+    self-patch.  Returns (friends int32[rows, cap] -1-padded, deg
+    int32[rows]).  Requires lam <= 60 (f32 pmf recurrence) and
+    BLOCK_ROWS-aligned row0."""
+    if not 0.0 < lam <= 60.0:
+        raise ValueError(f"erdos_pallas requires 0 < lam <= 60, got {lam}")
+    if row0 % BLOCK_ROWS:
+        raise ValueError(f"row0 must be {BLOCK_ROWS}-aligned, got {row0}")
+    from gossip_simulator_tpu.config import er_cap
+
+    cap = er_cap(lam)
+    if cap > LANES:
+        raise ValueError(f"erdos_pallas cap {cap} exceeds {LANES}")
+    nblocks = -(-rows // BLOCK_ROWS)
+    seed_arr = jnp.asarray(seed, dtype=jnp.int32).reshape((1,))
+    out = pl.pallas_call(
+        functools.partial(_erdos_kernel, n, lam, cap, row0),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((cap + 1, BLOCK_ROWS), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((cap + 1, nblocks * BLOCK_ROWS),
+                                       jnp.int32),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(seed_arr)
+    deg = jnp.minimum(out[0, :rows], cap)
+    slot = jnp.arange(cap, dtype=jnp.int32)[:, None]
+    friends = jnp.where(slot < deg[None, :], out[1:, :rows], -1)
+    return friends.T, deg
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 5))
 def kout_pallas(n: int, k: int, row0: int, rows: int, seed,
                 interpret: bool = False):

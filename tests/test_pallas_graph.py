@@ -7,7 +7,8 @@ import jax
 import numpy as np
 import pytest
 
-from gossip_simulator_tpu.ops.pallas_graph import BLOCK_ROWS, kout_pallas
+from gossip_simulator_tpu.ops.pallas_graph import (BLOCK_ROWS, erdos_pallas,
+                                                   kout_pallas)
 
 INTERPRET = jax.default_backend() != "tpu"
 
@@ -35,6 +36,39 @@ def test_rejects_bad_args():
         kout_pallas(100, 5, 7, 100, 0, INTERPRET)
 
 
+def test_erdos_shape_padding_and_self_patch():
+    n, rows, lam = 10_000, 2_000, 6.0
+    f, deg = erdos_pallas(n, lam, 0, rows, 42, INTERPRET)
+    f, deg = np.asarray(f), np.asarray(deg)
+    assert f.shape[0] == rows and deg.shape == (rows,)
+    cap = f.shape[1]
+    assert (deg <= cap).all() and (deg >= 0).all()
+    slot = np.arange(cap)[None, :]
+    live = slot < deg[:, None]
+    assert ((f >= 0) & (f < n))[live].all()
+    assert (f == -1)[~live].all()
+    ids = np.arange(rows)[:, None]
+    assert ((f != ids) | ~live).all()
+
+
+def test_erdos_shard_block_consistency():
+    n, lam = 10_000, 6.0
+    full_f, full_d = erdos_pallas(n, lam, 0, 2 * BLOCK_ROWS, 42, INTERPRET)
+    part_f, part_d = erdos_pallas(n, lam, BLOCK_ROWS, BLOCK_ROWS, 42,
+                                  INTERPRET)
+    np.testing.assert_array_equal(np.asarray(full_f)[BLOCK_ROWS:],
+                                  np.asarray(part_f))
+    np.testing.assert_array_equal(np.asarray(full_d)[BLOCK_ROWS:],
+                                  np.asarray(part_d))
+
+
+def test_erdos_rejects_bad_args():
+    with pytest.raises(ValueError, match="lam"):
+        erdos_pallas(100, 100.0, 0, 100, 0, INTERPRET)
+    with pytest.raises(ValueError, match="aligned"):
+        erdos_pallas(100, 5.0, 7, 100, 0, INTERPRET)
+
+
 @pytest.mark.skipif(INTERPRET, reason="interpret-mode PRNG is a zero stub")
 def test_distribution_on_tpu():
     n, k, rows = 100_000, 8, 8_192
@@ -43,3 +77,15 @@ def test_distribution_on_tpu():
     # Distinct seeds give distinct graphs.
     g = np.asarray(kout_pallas(n, k, 0, rows, 8, False))
     assert (f != g).mean() > 0.99
+
+
+@pytest.mark.skipif(INTERPRET, reason="interpret-mode PRNG is a zero stub")
+def test_erdos_distribution_on_tpu():
+    n, rows, lam = 100_000, 65_536, 8.0
+    f, deg = erdos_pallas(n, lam, 0, rows, 7, False)
+    f, deg = np.asarray(f), np.asarray(deg)
+    # Poisson(8): mean within 4 sigma, variance ~ mean.
+    assert abs(deg.mean() - lam) < 4 * np.sqrt(lam / rows)
+    assert abs(deg.var() / lam - 1) < 0.1
+    live = np.arange(f.shape[1])[None, :] < deg[:, None]
+    assert abs(f[live].mean() / (n / 2) - 1) < 0.02
