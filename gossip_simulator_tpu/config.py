@@ -235,10 +235,6 @@ class Config:
             if self.backend not in ("jax", "sharded"):
                 raise ValueError(
                     "engine=event requires backend=jax or sharded")
-            if self.protocol == "sir" and self.backend != "jax":
-                raise ValueError(
-                    "engine=event with protocol=sir runs on backend=jax "
-                    "(the sharded event engine is SI-only)")
         if self.time_mode not in TIME_MODES:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"

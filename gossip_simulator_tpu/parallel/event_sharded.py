@@ -20,6 +20,11 @@ delay streams, so trajectories differ from the single-device run but match
 it distributionally (tested).  Route-buffer overflow is counted in
 `exchange_overflow`; slot-capacity overflow in `mail_dropped` -- never
 silent.
+
+SIR: re-broadcast triggers are tagged SELF-messages and therefore always
+shard-local -- they append directly into the local ring
+(_append_local_triggers) and never touch the all_to_all; removal draws are
+shard-folded + row-keyed like delay/drop.
 """
 
 from __future__ import annotations
@@ -71,6 +76,31 @@ def make_sharded_event_init(cfg: Config, mesh):
                               out_specs=event_state_specs()))
 
 
+def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
+                 wslot, valid):
+    """Append one packed entry per True in `valid` into its `wslot` slot of
+    the local mail ring: rank within each slot via a one-hot cumsum
+    (emission order), bounds-checked against the slot capacity with
+    overflow counted in `dropped`, out-of-capacity writes diverted to the
+    dw*cap trash cell.  The single reservation path for both routed data
+    messages and shard-local SIR triggers."""
+    dw = event.ring_windows(cfg)
+    cap = (mail.shape[0] - event.drain_chunk(cfg, n_local)) // dw
+    oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+          & valid[:, None]).astype(I32)
+    rank = jnp.take_along_axis(
+        jnp.cumsum(oh, axis=0), jnp.where(valid, wslot, 0)[:, None],
+        axis=1)[:, 0] - 1
+    base = cnt[0, jnp.where(valid, wslot, 0)]
+    pos = base + rank
+    ok = valid & (pos < cap)
+    flat = jnp.where(ok, wslot * cap + pos, dw * cap)  # in-bounds trash cell
+    mail = mail.at[flat].set(jnp.where(ok, payload, 0))
+    cnt = cnt + (oh * ok[:, None]).sum(axis=0)[None, :]
+    dropped = dropped + (valid & ~ok).sum(dtype=I32)
+    return mail, cnt, dropped
+
+
 def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
                       dropped, xovf, dst_global, wslot, off, valid, rcap):
     """Route (global dst, window slot, tick offset) messages to their owner
@@ -80,7 +110,6 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     Returns (mail, cnt, dropped, xovf)."""
     b = event.batch_ticks(cfg)
     dw = event.ring_windows(cfg)
-    cap = (mail.shape[0] - event.drain_chunk(cfg, n_local)) // dw
     dest = jnp.where(valid, dst_global // n_local, n_shards)
     wire = jnp.where(
         valid,
@@ -91,22 +120,22 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     rdstl = r // (dw * b)
     rw = (r // b) % dw
     roff = r % b
-    payload = rdstl * b + roff
-    # Per-entry rank within each window slot (emission order).
-    oh = ((rw[:, None] == jnp.arange(dw, dtype=I32)[None, :])
-          & rvalid[:, None]).astype(I32)
-    rank = jnp.take_along_axis(
-        jnp.cumsum(oh, axis=0), jnp.where(rvalid, rw, 0)[:, None],
-        axis=1)[:, 0] - 1
-    base = cnt[0, jnp.where(rvalid, rw, 0)]
-    pos = base + rank
-    ok = rvalid & (pos < cap)
-    flat = jnp.where(ok, rw * cap + pos, dw * cap)  # in-bounds trash cell
-    mail = mail.at[flat].set(jnp.where(ok, payload, 0))
-    adds = (oh * ok[:, None]).sum(axis=0)
-    cnt = cnt + adds[None, :]
-    dropped = dropped + (rvalid & ~ok).sum(dtype=I32)
+    mail, cnt, dropped = _ring_append(
+        cfg, n_local, mail, cnt, dropped, rdstl * b + roff, rw, rvalid)
     return mail, cnt, dropped, xovf + ovf
+
+
+def _append_local_triggers(cfg: Config, n_local: int, mail, cnt, dropped,
+                           rows, strig, wslot, off):
+    """Append SIR re-broadcast triggers (tagged self-messages,
+    trigger_base + row*b + off) into the LOCAL mail ring -- triggers never
+    cross shards, so they skip the all_to_all entirely.  One entry per
+    True in `strig`; reservations are per-trigger (not per-sender), so an
+    all-False mask leaves the ring untouched."""
+    b = event.batch_ticks(cfg)
+    tb = event.trigger_base(n_local, b)
+    return _ring_append(cfg, n_local, mail, cnt, dropped,
+                        tb + rows * b + off, wslot, strig)
 
 
 def make_sharded_event_step(cfg: Config, mesh):
@@ -118,10 +147,16 @@ def make_sharded_event_step(cfg: Config, mesh):
     ccap = event.drain_chunk(cfg, n_local)
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
     drop_p = epidemic.p_eff(cfg, cfg.droprate)
+    sir = cfg.protocol == "sir"
+    removal_p = epidemic.p_eff(cfg, cfg.removal_rate) if sir else 0.0
     if n_local * dw * b >= 2**31:
         raise ValueError(
             f"wire packing overflow: n_local ({n_local}) * dw ({dw}) * B "
             f"({b}) must stay below 2^31; use more shards")
+    if sir and (2 * n_local + 3) * b >= 2**31:
+        raise ValueError(
+            f"SIR trigger packing overflow: (2*n_local+3) ({2*n_local+3}) "
+            f"* B ({b}) must stay below 2^31; use more shards")
 
     def step_shard(st: EventState, base_key: jax.Array) -> EventState:
         shard = jax.lax.axis_index(AXIS)
@@ -142,17 +177,18 @@ def make_sharded_event_step(cfg: Config, mesh):
             evalid = entry_pos < m
             packed = jax.lax.dynamic_slice(mail, (slot * cap + off0,),
                                            (ccap,))
-            flags, cdm, cdr, cdc, ids_s, toff_s, newly = \
+            flags, cdm, cdr, cdc, ids_s, toff_s, senders = \
                 event.drain_chunk_core(crash_p, b, n_local, flags,
                                        packed, evalid, entry_pos,
-                                       ckey)
+                                       ckey, sir=sir)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
-            # Newly infected (local rows) broadcast at their delivery tick;
-            # delay/drop keys are shard-folded + local-row-keyed, the same
-            # scheme the sharded ring engine uses.  No compaction (see the
-            # single-device step): `newly` masks ids_s directly, with
-            # identical reservation order.
-            svalid = newly
+            # Senders (newly infected, plus firing SIR triggers) broadcast
+            # at their delivery tick; delay/drop keys are shard-folded +
+            # local-row-keyed, the same scheme the sharded ring engine
+            # uses.  No compaction (see the single-device step): the mask
+            # feeds the emission directly, with identical reservation
+            # order.
+            svalid = senders
             sids = ids_s
             rows = jnp.where(svalid, sids, n_local)
             sticks = w * b + toff_s
@@ -175,6 +211,17 @@ def make_sharded_event_step(cfg: Config, mesh):
             arrive = sticks + delay
             wslot2 = (arrive // b) % dw
             off2 = arrive % b
+            if sir:
+                # Removal draw per sender at its send tick (same ordering
+                # as the single-device step); surviving senders schedule
+                # their next trigger locally -- triggers never cross
+                # shards, so no collective is involved.
+                rk = event._sender_keys(skey, _rng.OP_REMOVE, sticks, rows)
+                rem = jax.vmap(lambda kk: jax.random.bernoulli(
+                    kk, removal_p))(rk) & svalid if removal_p > 0.0 \
+                    else jnp.zeros(svalid.shape, bool)
+                flags = flags.at[jnp.where(rem, sids, n_local)].add(
+                    event.REMOVED, mode="drop")
             edge = (jnp.arange(kwidth, dtype=I32)[None, :] < scnt2[:, None]) \
                 & svalid[:, None] & ~drop & (sf >= 0)
             dstg = jnp.where(edge, sf, 0).reshape(-1)
@@ -183,6 +230,10 @@ def make_sharded_event_step(cfg: Config, mesh):
                 jnp.broadcast_to(wslot2[:, None], (ccap, kwidth)).reshape(-1),
                 jnp.broadcast_to(off2[:, None], (ccap, kwidth)).reshape(-1),
                 edge.reshape(-1), rcap)
+            if sir:
+                mail, cnt, dropped = _append_local_triggers(
+                    cfg, n_local, mail, cnt, dropped, rows, svalid & ~rem,
+                    wslot2, off2)
             return (flags, mail, cnt, dm, dr, dc, dropped, xovf)
 
         z = jnp.zeros((), I32)
@@ -235,7 +286,10 @@ def make_sharded_event_seed(cfg: Config, mesh):
         edge = (jnp.arange(kwidth, dtype=I32) < scnt) & ~drop & (sf >= 0) \
             & own
         flags, total_received = st.flags, st.total_received
-        if not cfg.compat_reference:
+        if cfg.protocol == "sir" or not cfg.compat_reference:
+            # SIR always marks the seed: trigger firing needs the received
+            # bit, and the reference has no SIR compat surface (same rule
+            # as the single-device engines).
             flags = flags | jnp.where(
                 (jnp.arange(n_local, dtype=I32) == srow) & own,
                 event.RECEIVED, jnp.uint8(0))
@@ -248,6 +302,16 @@ def make_sharded_event_seed(cfg: Config, mesh):
             jnp.zeros((), I32), jnp.where(edge, sf, 0),
             jnp.broadcast_to((arrive // b) % dw, (kwidth,)),
             jnp.broadcast_to(arrive % b, (kwidth,)), edge, rcap)
+        if cfg.protocol == "sir":
+            # The seed's removal draw decides its re-broadcast trigger
+            # (replicated key; only the owner shard appends).
+            kr = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_REMOVE)
+            keep = ~_rng.bernoulli(kr, epidemic.p_eff(cfg, cfg.removal_rate),
+                                   ())
+            mail, cnt, dropped = _append_local_triggers(
+                cfg, n_local, mail, cnt, dropped, srow[None],
+                (own & keep)[None], ((arrive // b) % dw)[None],
+                (arrive % b)[None])
         dropped, xovf = jax.lax.psum((dropped, xovf), AXIS)
         return st._replace(flags=flags, total_received=total_received,
                            mail_ids=mail, mail_cnt=cnt,

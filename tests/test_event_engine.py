@@ -38,12 +38,12 @@ def test_auto_engine_selection():
     # Explicit compact is a ring-engine request.
     assert Config(**{**BASE, "compact": "on"}).validate() \
         .engine_resolved == "ring"
-    # SIR runs on the event engine only by explicit request, jax backend only.
+    # SIR runs on the event engine only by explicit request.
     assert Config(**{**BASE, "engine": "event", "protocol": "sir"}) \
         .validate().engine_resolved == "event"
-    with pytest.raises(ValueError, match="sharded event engine is SI-only"):
-        Config(**{**BASE, "engine": "event", "protocol": "sir",
-                  "backend": "sharded"}).validate()
+    assert Config(**{**BASE, "engine": "event", "protocol": "sir",
+                     "backend": "sharded"}).validate() \
+        .engine_resolved == "event"
     with pytest.raises(ValueError, match="engine=event"):
         Config(**{**BASE, "engine": "event",
                   "protocol": "pushpull"}).validate()
@@ -236,6 +236,42 @@ def test_event_sir_dieout_exhausts():
 def test_event_sir_determinism():
     kw = dict(engine="event", protocol="sir", removal_rate=0.25,
               crashrate=0.01, coverage_target=0.9)
+    r1, _ = _run(**kw)
+    r2, _ = _run(**kw)
+    assert r1.stats == r2.stats
+
+
+def test_event_sharded_sir_removal_one_matches_si():
+    """Sharded event SIR with removal_rate=1 degenerates to sharded event
+    SI bit-for-bit (crashrate 0; triggers are never scheduled)."""
+    kw = dict(backend="sharded", n=4000, engine="event",
+              coverage_target=0.9)
+    sir, _ = _run(protocol="sir", removal_rate=1.0, **kw)
+    si, _ = _run(protocol="si", **kw)
+    assert sir.stats.total_message == si.stats.total_message
+    assert sir.stats.total_received == si.stats.total_received
+
+
+def test_event_sharded_sir_close_to_single_device():
+    """Sharded event SIR on the 8-fake-device mesh vs the single-device
+    event SIR: per-shard streams differ, totals agree statistically and
+    nothing overflows."""
+    kw = dict(protocol="sir", engine="event", removal_rate=0.25,
+              droprate=0.3, coverage_target=0.9, max_rounds=4000, n=4000)
+    sh, _ = _run(backend="sharded", **kw)
+    sj, _ = _run(backend="jax", **kw)
+    assert sh.converged and sj.converged
+    assert sh.stats.exchange_overflow == 0
+    assert sh.stats.mailbox_dropped == 0
+    assert abs(sh.stats.total_message - sj.stats.total_message) \
+        / max(sj.stats.total_message, 1) < 0.15
+    assert abs(sh.stats.total_received - sj.stats.total_received) \
+        / max(sj.stats.total_received, 1) < 0.05
+
+
+def test_event_sharded_sir_determinism():
+    kw = dict(backend="sharded", n=4000, engine="event", protocol="sir",
+              removal_rate=0.25, crashrate=0.01, coverage_target=0.9)
     r1, _ = _run(**kw)
     r2, _ = _run(**kw)
     assert r1.stats == r2.stats
