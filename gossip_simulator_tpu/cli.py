@@ -31,12 +31,39 @@ def _maybe_reexec_for_cpu(argv: Optional[list[str]]) -> None:
 
 def main(argv: Optional[list[str]] = None) -> int:
     cfg = parse_args(argv)
+    printer = None
     if cfg.backend in ("jax", "sharded"):
         _maybe_reexec_for_cpu(argv)
         from gossip_simulator_tpu.utils import jaxsetup
 
         jaxsetup.setup()
-    result = run_simulation(cfg)
+        if cfg.distributed:
+            # Every process runs this same CLI; jax.distributed wires them
+            # into one global runtime and the sharded backend's mesh spans
+            # ALL processes' devices (SURVEY §5.8 multi-slice path).  Only
+            # process 0 prints -- the totals are replicated everywhere.
+            import jax
+
+            kw = {}
+            if cfg.coordinator:
+                kw["coordinator_address"] = cfg.coordinator
+            if cfg.num_processes > 0:
+                kw["num_processes"] = cfg.num_processes
+            if cfg.process_id >= 0:
+                kw["process_id"] = cfg.process_id
+            jax.distributed.initialize(**kw)
+            rank0 = jax.process_index() == 0
+            from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+            printer = ProgressPrinter(
+                enabled=cfg.progress,
+                jsonl_path=(cfg.log_jsonl or None) if rank0 else None,
+                silent=not rank0)
+    try:
+        result = run_simulation(cfg, printer=printer)
+    finally:
+        if printer is not None:
+            printer.close()
     return 0 if result.converged else 2
 
 

@@ -117,6 +117,16 @@ class Config:
     # (jax backend; skips overlay construction and seeding).
     resume: bool = False
     progress: bool = True  # print reference-format progress lines
+    # Multi-host SPMD (backend=sharded): every participating process runs
+    # the same CLI with its own -process-id; jax.distributed wires them
+    # into one global device mesh (ICI within a slice, DCN across), the
+    # node axis shards over ALL processes' devices, and only process 0
+    # prints.  Empty coordinator/counts fall back to jax's automatic
+    # detection (TPU pod environments set them via the runtime).
+    distributed: bool = False
+    coordinator: str = ""  # e.g. "host0:1234"
+    num_processes: int = -1  # -1 = auto-detect
+    process_id: int = -1  # -1 = auto-detect
 
     # --- derived --------------------------------------------------------------
     @property
@@ -239,6 +249,13 @@ class Config:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"
             )
+        if self.distributed:
+            if self.backend != "sharded":
+                raise ValueError("-distributed requires -backend sharded")
+            if self.checkpoint_every or self.resume:
+                raise ValueError(
+                    "-distributed does not support checkpoint/resume yet "
+                    "(snapshots would need globally-addressable gathers)")
         if not 0.0 < self.coverage_target <= 1.0:
             raise ValueError(
                 f"coverage_target must be in (0,1], got {self.coverage_target}"
@@ -335,6 +352,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="resume from the latest snapshot in -checkpoint-dir")
     p.add_argument("-quiet", "--quiet", action="store_true",
                    help="suppress per-window progress lines")
+    p.add_argument("-distributed", "--distributed", action="store_true",
+                   help="multi-host SPMD: initialize jax.distributed and "
+                        "shard the node axis over every process's devices")
+    p.add_argument("-coordinator", "--coordinator", default=d.coordinator,
+                   help="jax.distributed coordinator address host:port "
+                        "(empty = auto-detect)")
+    p.add_argument("-num-processes", "--num-processes", dest="num_processes",
+                   type=int, default=d.num_processes)
+    p.add_argument("-process-id", "--process-id", dest="process_id",
+                   type=int, default=d.process_id)
     return p
 
 

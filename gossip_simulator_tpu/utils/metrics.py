@@ -59,16 +59,20 @@ class ProgressPrinter:
     """Reference-format progress lines plus optional JSONL structured log."""
 
     def __init__(self, enabled: bool = True, jsonl_path: Optional[str] = None,
-                 out=None):
+                 out=None, silent: bool = False):
         # enabled=False ("quiet") suppresses only the per-window progress
         # lines; parameters, phase summaries, and final totals always print.
+        # silent=True suppresses ALL stdout (non-zero ranks of a
+        # -distributed run, where every process would otherwise print the
+        # same replicated totals); JSONL records still flow if configured.
         self.enabled = enabled
+        self.silent = silent
         self.out = out or sys.stdout
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._t0 = time.perf_counter()
 
     def _emit(self, line: str, progress_only: bool = False, **record):
-        if self.enabled or not progress_only:
+        if not self.silent and (self.enabled or not progress_only):
             print(line, file=self.out, flush=True)
         if self._jsonl:
             record["wall_s"] = time.perf_counter() - self._t0

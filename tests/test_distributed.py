@@ -1,0 +1,75 @@
+"""Multi-host SPMD (-distributed): two real OS processes, each owning 4
+fake CPU devices, joined by jax.distributed into one 8-device mesh -- the
+DCN analog of SURVEY §5.8's multi-slice path, exercised end to end through
+the CLI.
+
+The global mesh (2 processes x 4 devices) has the same 8 shards as the
+in-process 8-device run the rest of the suite uses, and per-shard RNG
+streams depend only on shard index -- so the distributed totals must match
+the single-process totals EXACTLY."""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+ARGS = ["-n", "4000", "-graph", "kout", "-fanout", "6", "-seed", "5",
+        "-backend", "sharded", "-engine", "event",
+        "-coverage-target", "0.9", "-crashrate", "0.01", "-quiet"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank: int, port: int):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU plugin in the children
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    cmd = [sys.executable, "-m", "gossip_simulator_tpu", *ARGS,
+           "-distributed", "-coordinator", f"localhost:{port}",
+           "-num-processes", "2", "-process-id", str(rank)]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def test_two_process_run_matches_single_process():
+    port = _free_port()
+    procs = [_spawn(r, port) for r in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed run timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"rank failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    # Only rank 0 prints simulator output (rank 1's stdout may carry
+    # collective-backend chatter like Gloo connection notices).
+    assert "Total message" in outs[0][1]
+    assert "Total message" not in outs[1][1]
+    assert "covered" not in outs[1][1]
+    m = re.search(r"Total message (\d+) Total Crashed (\d+)", outs[0][1])
+    assert m, outs[0][1]
+    dist_msg, dist_crash = int(m.group(1)), int(m.group(2))
+
+    # Reference: same config on this process's own 8-device mesh.
+    cfg = Config(n=4000, graph="kout", fanout=6, seed=5, backend="sharded",
+                 engine="event", coverage_target=0.9, crashrate=0.01,
+                 progress=False).validate()
+    res = run_simulation(cfg, printer=ProgressPrinter(enabled=False))
+    assert dist_msg == res.stats.total_message
+    assert dist_crash == res.stats.total_crashed
