@@ -31,7 +31,7 @@ def _maybe_reexec_for_cpu(argv: Optional[list[str]]) -> None:
 
 def main(argv: Optional[list[str]] = None) -> int:
     cfg = parse_args(argv)
-    printer = None
+    silent = False
     if cfg.backend in ("jax", "sharded"):
         _maybe_reexec_for_cpu(argv)
         from gossip_simulator_tpu.utils import jaxsetup
@@ -52,18 +52,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             if cfg.process_id >= 0:
                 kw["process_id"] = cfg.process_id
             jax.distributed.initialize(**kw)
-            rank0 = jax.process_index() == 0
-            from gossip_simulator_tpu.utils.metrics import ProgressPrinter
-
-            printer = ProgressPrinter(
-                enabled=cfg.progress,
-                jsonl_path=(cfg.log_jsonl or None) if rank0 else None,
-                silent=not rank0)
-    try:
-        result = run_simulation(cfg, printer=printer)
-    finally:
-        if printer is not None:
-            printer.close()
+            silent = jax.process_index() != 0
+    result = run_simulation(cfg, silent=silent)
     return 0 if result.converged else 2
 
 

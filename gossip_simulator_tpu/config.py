@@ -256,6 +256,21 @@ class Config:
                 raise ValueError(
                     "-distributed does not support checkpoint/resume yet "
                     "(snapshots would need globally-addressable gathers)")
+            manual = (bool(self.coordinator), self.num_processes != -1,
+                      self.process_id != -1)
+            if any(manual) and not all(manual):
+                raise ValueError(
+                    "-coordinator, -num-processes and -process-id must be "
+                    "given together (or all omitted for jax's automatic "
+                    "cluster detection, e.g. on TPU pods)")
+            if all(manual):
+                if self.num_processes < 1:
+                    raise ValueError(
+                        f"-num-processes must be >= 1, got {self.num_processes}")
+                if not 0 <= self.process_id < self.num_processes:
+                    raise ValueError(
+                        f"-process-id must be in [0, {self.num_processes}), "
+                        f"got {self.process_id}")
         if not 0.0 < self.coverage_target <= 1.0:
             raise ValueError(
                 f"coverage_target must be in (0,1], got {self.coverage_target}"

@@ -30,11 +30,17 @@ class RunResult:
 
 
 def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
-                   stepper: Optional[Stepper] = None) -> RunResult:
+                   stepper: Optional[Stepper] = None,
+                   silent: bool = False) -> RunResult:
+    """`silent` mutes ALL output (and skips the JSONL log) -- the non-zero
+    ranks of a -distributed run, which compute the same replicated totals
+    as rank 0."""
     cfg = cfg.validate()
     own_printer = printer is None
-    printer = printer or ProgressPrinter(enabled=cfg.progress,
-                                         jsonl_path=cfg.log_jsonl or None)
+    printer = printer or ProgressPrinter(
+        enabled=cfg.progress,
+        jsonl_path=(cfg.log_jsonl or None) if not silent else None,
+        silent=silent)
     stepper = stepper or make_stepper(cfg)
 
     printer.params(cfg.parameter_dump())

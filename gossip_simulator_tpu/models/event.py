@@ -170,17 +170,18 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
 
 
 def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
-    """Drain chunk size: auto = clamp(n/64, 128k, 512k).
+    """Drain chunk size: auto = clamp(n/128, 128k, 512k).
 
-    Swept empirically on v5e.  n=1e7: 64k:752, 128k:769, 256k:718,
-    512k:623, 1M:487 M node-updates/s -- op cost grows superlinearly past
-    ~128k entries (sort passes, scatter contention), favoring small chunks.
-    n=1e8: 128k:303, 256k:782, 512k:903, 1M:880 -- the n-sized flag
-    gather/scatter per chunk grows with n, so fewer/larger chunks win.  The
-    n/64 ramp hits both optima."""
+    Swept empirically on v5e.  n=1e7: 64k:752, 128k:769->922 (post
+    friend_cnt removal), 156k:882, 256k:718->794, 512k:623, 1M:487
+    M node-updates/s -- op cost grows superlinearly past ~128k entries
+    (sort passes, scatter contention), favoring small chunks.  n=1e8:
+    128k:303, 256k:782, 512k:903, 1M:880 -- the n-sized flag
+    gather/scatter per chunk grows with n, so fewer/larger chunks win.
+    The n/128 ramp hits both optima."""
     n = n_local if n_local is not None else cfg.n
     want = cfg.event_chunk if cfg.event_chunk > 0 else \
-        min(524_288, max(131_072, n // 64))
+        min(524_288, max(131_072, n // 128))
     return min(slot_cap(cfg, n_local), max(256, want))
 
 
@@ -236,7 +237,9 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     rows = jnp.where(svalid, sender_ids, n)
     sidx = jnp.where(svalid, sender_ids, 0)
     sf = friends.at[sidx].get()
-    scnt = jnp.where(svalid, friend_cnt[sidx], 0)
+    del friend_cnt  # not gathered: rows are prefix-compact, (sf >= 0) is the
+    # edge mask (every generator -1-pads the tail; overlay.py appends at cnt
+    # and swap-fills holes) -- profiled at ~1 ms/chunk, ~8% of the drain.
     dk = _sender_keys(base_key, _rng.OP_DELAY, sticks, rows)
     pk = _sender_keys(base_key, _rng.OP_DROP, sticks, rows)
     delay = jnp.maximum(jax.vmap(
@@ -253,8 +256,7 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     arrive = sticks + delay
     wslot = (arrive // b) % dw
     off = arrive % b
-    edge = (jnp.arange(k, dtype=I32)[None, :] < scnt[:, None]) \
-        & svalid[:, None] & ~drop & (sf >= 0)
+    edge = svalid[:, None] & ~drop & (sf >= 0)
     # Per-sender rank among same-window-slot senders (emission order).
     oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
           & svalid[:, None]).astype(I32)

@@ -194,7 +194,8 @@ def make_sharded_event_step(cfg: Config, mesh):
             sticks = w * b + toff_s
             sidx = jnp.where(svalid, sids, 0)
             sf = st.friends.at[sidx].get()
-            scnt2 = jnp.where(svalid, st.friend_cnt[sidx], 0)
+            # No friend_cnt gather: rows are prefix-compact, (sf >= 0) is
+            # the edge mask (see append_messages).
             dk = event._sender_keys(skey, _rng.OP_DELAY, sticks, rows)
             pk = event._sender_keys(skey, _rng.OP_DROP, sticks, rows)
             delay = jnp.maximum(jax.vmap(
@@ -222,8 +223,7 @@ def make_sharded_event_step(cfg: Config, mesh):
                     else jnp.zeros(svalid.shape, bool)
                 flags = flags.at[jnp.where(rem, sids, n_local)].add(
                     event.REMOVED, mode="drop")
-            edge = (jnp.arange(kwidth, dtype=I32)[None, :] < scnt2[:, None]) \
-                & svalid[:, None] & ~drop & (sf >= 0)
+            edge = svalid[:, None] & ~drop & (sf >= 0)
             dstg = jnp.where(edge, sf, 0).reshape(-1)
             mail, cnt, dropped, xovf = _route_and_append(
                 cfg, s, n_local, mail, cnt, dropped, xovf, dstg,

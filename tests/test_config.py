@@ -73,3 +73,24 @@ def test_parse_args_quiet_and_extensions():
 def test_effective_time_mode_pushpull_is_rounds():
     assert Config(protocol="pushpull").effective_time_mode == "rounds"
     assert Config(protocol="si").effective_time_mode == "ticks"
+
+
+def test_distributed_flag_validation():
+    import pytest
+
+    base = dict(n=1000, backend="sharded", distributed=True, progress=False)
+    Config(**base).validate()  # full auto-detect is fine
+    Config(**base, coordinator="h:1", num_processes=2,
+           process_id=0).validate()
+    with pytest.raises(ValueError, match="given together"):
+        Config(**base, coordinator="h:1").validate()
+    with pytest.raises(ValueError, match="process-id must be in"):
+        Config(**base, coordinator="h:1", num_processes=2,
+               process_id=2).validate()
+    with pytest.raises(ValueError, match="num-processes"):
+        Config(**base, coordinator="h:1", num_processes=0,
+               process_id=0).validate()
+    with pytest.raises(ValueError, match="backend sharded"):
+        Config(n=1000, backend="jax", distributed=True).validate()
+    with pytest.raises(ValueError, match="checkpoint"):
+        Config(**base, checkpoint_every=5, checkpoint_dir="/tmp/x").validate()
