@@ -117,7 +117,7 @@ class CppStepper(Stepper):
         return st
 
     def stats(self) -> Stats:
-        buf = (ctypes.c_int64 * 6)()
+        buf = (ctypes.c_int64 * 7)()
         self._lib.sim_stats(self._h, buf)
         self._exhausted = bool(buf[5]) and self.cfg.protocol != "pushpull"
         return Stats(
@@ -125,7 +125,7 @@ class CppStepper(Stepper):
             round=int(self.sim_time_ms()),
             total_received=int(buf[0]), total_message=int(buf[1]),
             total_crashed=int(buf[2]), makeups=int(buf[3]),
-            breakups=int(buf[4]),
+            breakups=int(buf[4]), total_removed=int(buf[6]),
         )
 
     def sim_time_ms(self) -> float:

@@ -105,13 +105,15 @@ class JaxStepper(Stepper):
         device_get is a synchronous hop through the TPU tunnel)."""
         st = self.state
         extra = st.mail_dropped if hasattr(st, "mail_dropped") else 0
-        tm, tr, tc, tick, dropped, in_flight = jax.device_get(
+        rem = (event.removed_count(st)
+               if self.cfg.protocol == "sir" else 0)
+        tm, tr, tc, trm, tick, dropped, in_flight = jax.device_get(
             (st.total_message, st.total_received, st.total_crashed,
-             st.tick, extra, event.in_flight(st)))
+             rem, st.tick, extra, event.in_flight(st)))
         return Stats(
             n=self.cfg.n, round=int(tick),
             total_received=int(tr), total_message=int(tm),
-            total_crashed=int(tc),
+            total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
         ), int(in_flight)
 

@@ -303,6 +303,7 @@ class NativeStepper(Stepper):
             total_received=self.total_received,
             total_message=self.total_message,
             total_crashed=self.total_crashed,
+            total_removed=int(self.removed.sum()),
             makeups=self.makeups,
             breakups=self.breakups,
         )

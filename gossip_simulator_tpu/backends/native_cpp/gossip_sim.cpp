@@ -318,6 +318,13 @@ void sim_stats(void* h, int64_t* out) {
   out[3] = s->makeups;
   out[4] = s->breakups;
   out[5] = s->exhausted ? 1 : 0;
+  // SIR only: removed[] is provably all-zero otherwise and this scan is
+  // inside the benchmarked polling path.
+  int64_t rem = 0;
+  if (s->p.protocol == SIR) {
+    for (uint8_t r : s->removed) rem += r;
+  }
+  out[6] = rem;
 }
 
 double sim_now(void* h) { return static_cast<Sim*>(h)->now; }

@@ -132,15 +132,19 @@ class ShardedStepper(Stepper):
         return run_bounded_to_target(self)
 
     def stats(self) -> Stats:
+        from gossip_simulator_tpu.models import event as event_mod
+
         st = self.state
         extra = st.mail_dropped if hasattr(st, "mail_dropped") else 0
-        tm, tr, tc, xo, tick, dropped = jax.device_get(
+        rem = (event_mod.removed_count(st)
+               if self.cfg.protocol == "sir" else 0)
+        tm, tr, tc, trm, xo, tick, dropped = jax.device_get(
             (st.total_message, st.total_received, st.total_crashed,
-             st.exchange_overflow, st.tick, extra))
+             rem, st.exchange_overflow, st.tick, extra))
         return Stats(
             n=self.cfg.n, round=int(tick),
             total_received=int(tr), total_message=int(tm),
-            total_crashed=int(tc),
+            total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
             exchange_overflow=int(xo),
         )

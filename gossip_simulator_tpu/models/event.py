@@ -552,3 +552,12 @@ def in_flight(st) -> jnp.ndarray:
     if hasattr(st, "mail_cnt"):
         return st.mail_cnt.sum()
     return st.pending.sum() + st.rebroadcast.sum()
+
+
+def removed_count(st) -> jnp.ndarray:
+    """SIR removed-node count, engine-agnostic: no counter is threaded
+    through the hot loop -- the removed set lives in the state (flags bit2 /
+    SimState.removed), one O(n) reduction per host poll."""
+    if hasattr(st, "flags"):
+        return ((st.flags & REMOVED) > 0).sum(dtype=I32)
+    return st.removed.sum(dtype=I32)

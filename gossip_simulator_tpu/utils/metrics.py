@@ -32,6 +32,7 @@ class Stats:
     total_received: int = 0  # nodes infected (reference: TotalReceived)
     total_message: int = 0  # messages delivered to live nodes (TotalMessage)
     total_crashed: int = 0  # nodes crashed by reception (TotalCrashed)
+    total_removed: int = 0  # SIR: nodes that stopped re-broadcasting
     makeups: int = 0  # membership events this run (MakeUps)
     breakups: int = 0  # (BreakUps)
     mailbox_dropped: int = 0  # framework-only: capacity-overflow drops

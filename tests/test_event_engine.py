@@ -233,6 +233,20 @@ def test_event_sir_dieout_exhausts():
     assert res.gossip_windows < 100
 
 
+def test_sir_reports_removed_count():
+    """total_removed surfaces the SIR removed set on every backend (no
+    hot-loop counter: it is reduced from state at poll time)."""
+    kw = dict(protocol="sir", removal_rate=0.4, coverage_target=0.9)
+    for engine in ("event", "ring"):
+        res, _ = _run(engine=engine, **kw)
+        assert 0 < res.stats.total_removed <= res.stats.total_received + 1
+    for backend in ("native", "cpp"):
+        res, _ = _run(backend=backend, **kw)
+        assert 0 < res.stats.total_removed <= res.stats.total_received + 1
+    si, _ = _run(engine="event")
+    assert si.stats.total_removed == 0
+
+
 def test_event_sir_determinism():
     kw = dict(engine="event", protocol="sir", removal_rate=0.25,
               crashrate=0.01, coverage_target=0.9)
