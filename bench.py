@@ -85,6 +85,7 @@ def _bench_oracle(cfg: Config, budget_s: float = 20.0) -> dict:
         "n": cfg.n, "ticks": ticks, "run_s": run_s,
         "coverage": st.coverage,
         "node_updates_per_sec": cfg.n * ticks / run_s if run_s > 0 else 0.0,
+        "converged": st.coverage >= cfg.coverage_target,
     }
 
 

@@ -49,10 +49,25 @@ def _build_lib() -> str:
 _lib = None
 
 
+ABI_VERSION = 2  # must match sim_abi_version() in gossip_sim.cpp
+
+
 def load_lib():
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(_build_lib())
+        try:
+            got = lib.sim_abi_version()
+        except AttributeError:
+            got = 1
+        if got != ABI_VERSION:
+            # Reachable only via the stale-prebuilt-library fallback in
+            # _build_lib (no g++ to rebuild); newer fields (e.g. the SIR
+            # removed count in sim_stats[6]) would read as silent zeros.
+            raise RuntimeError(
+                f"{_LIB} implements C ABI v{got}, this build needs "
+                f"v{ABI_VERSION}; rebuild it (g++ required) or remove the "
+                "stale library")
         lib.sim_create.restype = ctypes.c_void_p
         lib.sim_create.argtypes = [
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,

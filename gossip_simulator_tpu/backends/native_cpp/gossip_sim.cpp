@@ -285,6 +285,11 @@ struct Sim {
 
 extern "C" {
 
+// Bump when the C ABI changes (slots in sim_stats etc.); cpp.py checks it
+// so a stale prebuilt library cannot silently misreport new fields.
+// v2: sim_stats gained out[6] = SIR removed count.
+int32_t sim_abi_version() { return 2; }
+
 void* sim_create(int64_t n, int32_t fanout, int32_t fanin, int32_t delaylow,
                  int32_t delayhigh, double droprate, double crashrate,
                  double removal_rate, double er_lambda, int32_t protocol,

@@ -118,7 +118,10 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     stats = stepper.stats()
     # A snapshot restored at/after the cap may already be at target.
     converged = converged or stats.coverage >= target
-    printer.done(coverage_ms, stats, target_pct=target * 100.0, converged=converged)
+    reason = ("exhausted: no messages in flight"
+              if getattr(stepper, "exhausted", False) else "max rounds")
+    printer.done(coverage_ms, stats, target_pct=target * 100.0,
+                 converged=converged, reason=reason)
     if own_printer:
         printer.close()
     return RunResult(

@@ -101,17 +101,19 @@ class ProgressPrinter:
                    progress_only=True, event="coverage", pct=pct, sim_ms=sim_ms)
 
     def done(self, sim_ms: float, stats: Stats, target_pct: float = 99.0,
-             converged: bool = True):
+             converged: bool = True, reason: str = "max rounds"):
         if converged:
             self._emit(f"--- Took {fmt_sim_ms(sim_ms)} to get {target_pct:g}% ---\n",
                        event="done", sim_ms=sim_ms, **stats.to_dict())
         else:
             # Reference has no liveness bound and would spin forever
-            # (simulator.go:243-251); we report non-convergence explicitly.
+            # (simulator.go:243-251); we report non-convergence explicitly,
+            # with the actual cause (cap hit vs wave died out).
             self._emit(
                 f"--- Did NOT reach {target_pct:g}% after {fmt_sim_ms(sim_ms)} "
-                f"(max rounds) ---\n",
-                event="nonconvergence", sim_ms=sim_ms, **stats.to_dict())
+                f"({reason}) ---\n",
+                event="nonconvergence", sim_ms=sim_ms, reason=reason,
+                **stats.to_dict())
         self._emit(
             f"Total message {stats.total_message} Total Crashed {stats.total_crashed}",
             event="totals", **stats.to_dict())

@@ -240,7 +240,15 @@ def test_sir_reports_removed_count():
     for engine in ("event", "ring"):
         res, _ = _run(engine=engine, **kw)
         assert 0 < res.stats.total_removed <= res.stats.total_received + 1
-    for backend in ("native", "cpp"):
+    import os
+    import shutil
+
+    from gossip_simulator_tpu.backends import cpp as cpp_mod
+
+    backends = ["native"]
+    if shutil.which("g++") or os.path.exists(cpp_mod._LIB):
+        backends.append("cpp")
+    for backend in backends:
         res, _ = _run(backend=backend, **kw)
         assert 0 < res.stats.total_removed <= res.stats.total_received + 1
     si, _ = _run(engine="event")
