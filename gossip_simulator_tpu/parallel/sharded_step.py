@@ -194,10 +194,13 @@ def make_sharded_pushpull(cfg: Config, mesh):
         tvalid = rtgt >= 0
         tgt_idx = jnp.where(tvalid, rtgt, 0)
         # A live peer answers any request (counted); an infected live peer's
-        # answer infects.
-        answered = tvalid & ~st.crashed[tgt_idx]
+        # answer infects.  One packed gather answers both (pre-round crashed,
+        # like the single-device round; see epidemic.packed_peer_state).
+        peer_state = epidemic.packed_peer_state(st.received,
+                                                st.crashed)[tgt_idx]
+        answered = tvalid & (peer_state < 2)
         dm = dm + answered.sum(dtype=I32)
-        hit = answered & st.received[tgt_idx]
+        hit = answered & (peer_state == 1)
         back, ovf4 = exchange.route_one(
             jnp.where(hit, rreq % n_local, -1),
             jnp.where(hit, rreq // n_local, s), hit, s, cap)
