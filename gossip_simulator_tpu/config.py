@@ -157,11 +157,20 @@ class Config:
     def graph_width(self) -> int:
         """Actual friends-table column count for this config's graph: the
         Erdős–Rényi generators pad to the Poisson tail cap (er_cap), which
-        can be ~3x max_degree -- ring sizing (event.slot_cap) must use THIS,
-        not max_degree, or per-sender reservations overflow."""
+        can be ~3x max_degree.  This bounds a single sender's reservation;
+        aggregate in-flight sizing uses mean_degree (reservations are
+        exact-size, so padding never reaches the mail ring)."""
         if self.graph == "erdos":
             return er_cap(self.er_p_resolved * self.n)
         return self.max_degree
+
+    @property
+    def mean_degree(self) -> float:
+        """Expected out-degree -- the right per-node in-flight budget for
+        the event engine's exact-size mail reservations (event.slot_cap)."""
+        if self.graph == "erdos":
+            return self.er_p_resolved * self.n
+        return float(self.max_degree)
 
     @property
     def effective_time_mode(self) -> str:

