@@ -97,7 +97,7 @@ class Config:
     # ring-engine request), ring otherwise.
     engine: str = "auto"
     # Event engine per-WINDOW-slot message capacity (-1 = auto: see
-    # event.slot_cap -- 1.5*n*max_degree*B/delay_span, bounded by the SI
+    # event.slot_cap -- 1.5*n*mean_degree*B/delay_span, bounded by the SI
     # message total and int32 flat addressing; overflow is counted in
     # Stats.mailbox_dropped, never silent).
     event_slot_cap: int = -1

@@ -58,8 +58,8 @@ epidemic.make_tick_fn).
 
 Capacity: slot_cap(cfg) packed entries per window slot; appends beyond it
 are dropped and counted in `mail_dropped` (Stats.mailbox_dropped), never
-silent.  SI in-flight is bounded by n * max_degree spread over the delay
-span; the default covers peak skew ~1.5x over.
+silent.  Reservations are exact-size, so SI in-flight is ~n * mean_degree
+spread over the delay span; the default covers peak skew ~1.5x over.
 """
 
 from __future__ import annotations

@@ -1,0 +1,70 @@
+"""Seeded random-config sweep asserting structural invariants.
+
+Complements the targeted parity tests: any (protocol, graph, engine,
+time-mode, rates) combination must respect the counters' algebra --
+deterministic properties only, so the sweep cannot flake."""
+
+import random
+
+import pytest
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.driver import run_simulation
+
+
+def _random_cfg(i: int) -> Config:
+    # Per-case RNG: case i's config must not depend on which other cases
+    # ran (isolation / pytest-xdist reproducibility).
+    rng = random.Random(0xC0FFEE ^ i)
+    protocol = rng.choice(["si", "si", "sir", "pushpull"])
+    graph = rng.choice(["kout", "erdos", "ring", "overlay"])
+    engine = rng.choice(["auto", "ring"]
+                        + (["event"] if protocol != "pushpull" else []))
+    time_mode = rng.choice(["ticks", "ticks", "rounds"])
+    if engine == "event":
+        time_mode = "ticks"
+    return Config(
+        n=rng.randrange(500, 3000),
+        fanout=rng.randrange(2, 8),
+        graph=graph,
+        protocol=protocol,
+        engine=engine,
+        time_mode=time_mode,
+        droprate=rng.choice([0.0, 0.1, 0.4]),
+        crashrate=rng.choice([0.0, 0.0, 0.02]),
+        removal_rate=rng.choice([0.1, 0.5]),
+        seed=i,
+        backend="jax",
+        coverage_target=0.9,
+        max_rounds=4000,
+        progress=False,
+    ).validate()
+
+
+@pytest.mark.parametrize("i", range(8))
+def test_counter_algebra_holds(i):
+    cfg = _random_cfg(i)
+    res = run_simulation(cfg, silent=True)
+    st = res.stats
+    n = cfg.n
+    # Infection set and crash set are node sets.
+    assert 0 <= st.total_received <= n
+    assert 0 <= st.total_crashed <= n
+    assert 0 <= st.total_removed <= n
+    # Every infection (except possibly the self-marked seed) rode a
+    # delivered message; every crash was triggered by one.
+    assert st.total_received <= st.total_message + 1
+    assert st.total_crashed <= st.total_message
+    # Removal only happens to infected senders.
+    assert st.total_removed <= st.total_received
+    if cfg.protocol != "sir":
+        assert st.total_removed == 0
+    # Overflow counters are never negative and SI message totals are
+    # bounded by the edge budget (every node broadcasts at most once).
+    assert st.mailbox_dropped >= 0 and st.exchange_overflow >= 0
+    if cfg.protocol == "si":
+        assert st.total_message + st.mailbox_dropped \
+            <= (st.total_received + 1) * cfg.graph_width
+    # Determinism: the exact same config replays to the exact same stats.
+    res2 = run_simulation(cfg, silent=True)
+    assert res2.stats == st
