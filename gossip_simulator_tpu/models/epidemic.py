@@ -461,8 +461,12 @@ def run_call_budget(cfg: Config) -> int:
     run for minutes at large n, long enough to trip device-runtime watchdogs
     (observed as UNAVAILABLE faults at n=1e7 on v5e through the remote
     tunnel), so the host loop re-enters a bounded call until done -- same
-    compiled executable, same trajectory (keys depend only on tick)."""
-    return max(64, min(cfg.max_rounds, int(3.3e9 // max(cfg.n, 1))))
+    compiled executable, same trajectory (keys depend only on tick).  The
+    1024 cap bounds how long a dead wave can spin before the host-side
+    exhaustion check sees it (the single-device event engine also exits on
+    its device-side in-flight term; the ring and sharded engines rely on
+    this granularity)."""
+    return max(64, min(cfg.max_rounds, 1024, int(3.3e9 // max(cfg.n, 1))))
 
 
 def make_run_to_coverage_fn(cfg: Config):

@@ -144,10 +144,11 @@ def ring_windows(cfg: Config, n_local: int | None = None) -> int:
 
 
 def slot_cap(cfg: Config, n_local: int | None = None) -> int:
-    """Packed entries per window slot.  SI total in-flight <= n * max_degree
-    spread over delay_span ticks; a window aggregates B ticks of it, 1.5x
-    covers skew (overflow is counted, never silent).  Clamped so the flat
-    scatter index dw * cap stays in int32."""
+    """Packed entries per window slot.  Reservations are exact-size, so SI
+    total in-flight is ~n * mean_degree spread over delay_span ticks; a
+    window aggregates B ticks of it, 1.5x covers skew (overflow is counted,
+    never silent).  Clamped so the flat scatter index dw * cap stays in
+    int32."""
     n = n_local if n_local is not None else cfg.n
     b = batch_ticks(cfg, n_local)
     dw = ring_windows(cfg, n_local)
@@ -543,16 +544,29 @@ def make_run_to_coverage_fn(cfg: Config):
     (epidemic.make_run_to_coverage_fn / base.run_bounded_to_target)."""
     step = make_window_step_fn(cfg)
     max_steps = cfg.max_rounds
+    # One while iteration advances a full 10 ms poll window (ceil(10/B)
+    # B-tick steps), the SAME cadence the windowed driver path checks at --
+    # with B < 10 a per-step check would stop earlier and report different
+    # totals for the same config depending on the observation mode.  (10 =
+    # base.WINDOW_MS, hardcoded like the ring engine's run fn to keep
+    # models/ free of backends/ imports.)
+    steps = max(1, -(-10 // batch_ticks(cfg)))
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run_fn(st: EventState, base_key: jax.Array, target_count: jax.Array,
                until: jax.Array) -> EventState:
         def cond(s: EventState):
+            # The in-flight term (a dw-element sum -- free) stops the loop
+            # the moment the wave dies instead of spinning empty windows to
+            # max_rounds (the host-side exhaustion check only runs between
+            # bounded calls).
             return ((s.total_received < target_count)
-                    & (s.tick < max_steps) & (s.tick < until))
+                    & (s.tick < max_steps) & (s.tick < until)
+                    & (s.mail_cnt.sum() > 0))
 
         def body(s: EventState):
-            return step(s, base_key)
+            return jax.lax.fori_loop(
+                0, steps, lambda _, x: step(x, base_key), s)
 
         return jax.lax.while_loop(cond, body, st)
 

@@ -72,6 +72,13 @@ class ProgressPrinter:
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._t0 = time.perf_counter()
 
+    @property
+    def observing(self) -> bool:
+        """True when per-window callbacks are visible somewhere (stdout
+        progress lines or the JSONL log) -- the driver may skip the
+        windowed loop entirely otherwise."""
+        return (self.enabled and not self.silent) or self._jsonl is not None
+
     def _emit(self, line: str, progress_only: bool = False, **record):
         if not self.silent and (self.enabled or not progress_only):
             print(line, file=self.out, flush=True)

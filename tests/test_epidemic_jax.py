@@ -119,13 +119,20 @@ def test_pushpull_converges():
 
 
 def test_run_to_target_fast_path_matches_windows():
+    import io
+
     cfg = Config(**{**BASE, "progress": False}).validate()
     s = JaxStepper(cfg)
     s.init()
     s.seed()
     fast = s.run_to_target()
     assert fast.coverage >= cfg.coverage_target
-    res, _ = _run(**BASE)
+    # The reference run must take the WINDOWED driver loop: an observing
+    # printer disables the driver's run_to_target fast path.
+    wcfg = Config(**{**BASE, "progress": False}).validate()
+    printer = ProgressPrinter(enabled=True, out=io.StringIO())
+    assert printer.observing
+    res = run_simulation(wcfg, printer=printer)
     # Same seed: the windowed path and the while_loop path agree exactly
     # (same tick function, same fold_in randomness).
     assert fast.total_message == res.stats.total_message

@@ -25,6 +25,19 @@ def _run(**kw):
     return run_simulation(cfg, printer=ProgressPrinter(enabled=False)), cfg
 
 
+def _run_windowed(**kw):
+    """Force the driver's WINDOWED loop (an observing printer disables the
+    run_to_target fast path) -- the reference side of fast-vs-windowed
+    parity tests must not silently compare the fast path to itself."""
+    import io
+
+    kw = {**BASE, **kw}
+    cfg = Config(**kw).validate()
+    printer = ProgressPrinter(enabled=True, out=io.StringIO())
+    assert printer.observing
+    return run_simulation(cfg, printer=printer), cfg
+
+
 def test_auto_engine_selection():
     assert Config(**BASE).validate().engine_resolved == "event"
     assert Config(**{**BASE, "protocol": "sir"}).validate() \
@@ -86,9 +99,20 @@ def test_event_run_to_target_matches_windows():
     s.seed()
     fast = s.run_to_target()
     assert fast.coverage >= cfg.coverage_target
-    res, _ = _run(engine="event")
+    res, _ = _run_windowed(engine="event")
     assert fast.total_message == res.stats.total_message
     assert fast.total_received == res.stats.total_received
+
+
+def test_fast_and_windowed_agree_at_small_batch():
+    """delaylow < 10 makes the event batch B < 10: the run_to_target
+    while_loop must still check its stop condition at the windowed path's
+    10 ms cadence, or the two observation modes report different totals
+    for the same config (regression: caught at delaylow=2)."""
+    kw = dict(engine="event", delaylow=2, delayhigh=20, coverage_target=0.9)
+    fast, _ = _run(**kw)
+    win, _ = _run_windowed(**kw)
+    assert fast.stats == win.stats
 
 
 def test_event_exhaustion_terminates():
@@ -184,7 +208,7 @@ def test_event_sharded_run_to_target_matches_windows():
     s.seed()
     fast = s.run_to_target()
     assert fast.coverage >= cfg.coverage_target
-    res, _ = _run(backend="sharded", n=4000)
+    res, _ = _run_windowed(backend="sharded", n=4000)
     assert fast.total_message == res.stats.total_message
     assert fast.total_received == res.stats.total_received
 
