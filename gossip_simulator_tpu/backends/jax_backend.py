@@ -64,6 +64,10 @@ class JaxStepper(Stepper):
              overlay.quiesced(self.ostate)))
         if bool(q):
             self._overlay_done = True
+            # Freeze phase-1 elapsed time: once the epidemic state exists,
+            # sim_time_ms switches to its tick (which starts at 0), so the
+            # driver's "Took Xms to stabilize" needs this snapshot.
+            self._stabilize_ms = self._overlay_rounds * self._mean_delay
             self._mailbox_dropped = int(jax.device_get(
                 self.ostate.mailbox_dropped))
             self.state = self._engine.init_state(
@@ -73,7 +77,7 @@ class JaxStepper(Stepper):
 
     # --- phase 2 ---------------------------------------------------------------
     def seed(self) -> None:
-        self._phase2_start_rounds = self._overlay_rounds
+        self._seeded = True
         self.state = self._seed_fn(self.state, self.key)
 
     def gossip_window(self) -> Stats:
@@ -123,6 +127,10 @@ class JaxStepper(Stepper):
     def sim_time_ms(self) -> float:
         if self.state is None or not self._overlay_done:
             return self._overlay_rounds * self._mean_delay
+        if not getattr(self, "_seeded", False):
+            # Between quiescence and the broadcast: phase-1 elapsed time
+            # (the epidemic tick is 0 and would misreport stabilization).
+            return getattr(self, "_stabilize_ms", 0.0)
         return float(jax.device_get(self.state.tick))
 
     # --- checkpoint ------------------------------------------------------------
@@ -229,3 +237,4 @@ class JaxStepper(Stepper):
         self.state = cls(**{k: jax.numpy.asarray(v)
                             for k, v in tree.items()})
         self._overlay_done = True
+        self._seeded = True  # snapshots are taken mid-phase-2

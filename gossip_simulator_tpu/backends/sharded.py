@@ -76,6 +76,8 @@ class ShardedStepper(Stepper):
              overlay.quiesced(self.ostate)))
         if bool(q):
             self._overlay_done = True
+            # Freeze phase-1 elapsed time (see JaxStepper.overlay_window).
+            self._stabilize_ms = self._overlay_rounds * self._mean_delay
             self._mailbox_dropped = int(
                 jax.device_get(self.ostate.mailbox_dropped))
             self.state = self._epidemic_from_overlay()
@@ -106,6 +108,7 @@ class ShardedStepper(Stepper):
 
     # --- phase 2 ---------------------------------------------------------------
     def seed(self) -> None:
+        self._seeded = True
         self.state = self._seed_fn(self.state, self.key)
 
     def gossip_window(self) -> Stats:
@@ -152,6 +155,9 @@ class ShardedStepper(Stepper):
     def sim_time_ms(self) -> float:
         if self.state is None or not self._overlay_done:
             return self._overlay_rounds * self._mean_delay
+        if not getattr(self, "_seeded", False):
+            # Between quiescence and the broadcast: phase-1 elapsed time.
+            return getattr(self, "_stabilize_ms", 0.0)
         return float(jax.device_get(self.state.tick))
 
     def state_pytree(self):

@@ -143,8 +143,14 @@ def make_round_fn(cfg: Config,
             mk_em = mk_em.at[:, slot].set(jnp.where(rp, nf, -1))
             return friends, cnt, mk_em, win_bk + has.sum(dtype=I32)
 
+        # Slot loops run to the MAX mailbox load this round, not the fixed
+        # capacity: slots are rank-contiguous, so everything past a node's
+        # count is -1 (a no-op slot), and typical max load is ~ln n/ln ln n
+        # << cap.  Local data-dependent trip counts are fine under
+        # shard_map: the bodies contain no collectives.
+        n_bk = (bk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
         friends, cnt, mk_em, win_bk = jax.lax.fori_loop(
-            0, cap, bk_body, (friends, cnt, mk_em, win_bk))
+            0, n_bk, bk_body, (friends, cnt, mk_em, win_bk))
 
         # --- 3. process makeup mailbox -------------------------------------
         # simulator.go:66-75.
@@ -167,8 +173,9 @@ def make_round_fn(cfg: Config,
             bk_em = bk_em.at[:, slot].set(jnp.where(ev, victim, -1))
             return friends, cnt, bk_em, win_mk + has.sum(dtype=I32)
 
+        n_mk = (mk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
         friends, cnt, bk_em, win_mk = jax.lax.fori_loop(
-            0, cap, mk_body, (friends, cnt, bk_em, win_mk))
+            0, n_mk, mk_body, (friends, cnt, bk_em, win_mk))
 
         # --- 4. bootstrap: one friend per round while under fanout ---------
         # simulator.go:95-106.

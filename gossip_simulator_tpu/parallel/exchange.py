@@ -24,37 +24,54 @@ from gossip_simulator_tpu.parallel.mesh import AXIS
 I32 = jnp.int32
 
 
-def route_one(payload: jnp.ndarray, dest_shard: jnp.ndarray,
-              valid: jnp.ndarray, n_shards: int, cap: int,
-              axis: str = AXIS):
-    """Exchange one int32 payload array.
+def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
+                n_shards: int, cap: int, axis: str = AXIS):
+    """Exchange several int32 payload arrays that share one (dest, valid)
+    keying: ONE stable sort carries all payloads, the per-payload buffers
+    concatenate into a single all_to_all.  Same fast pattern as
+    ops/mailbox.deliver (payload-carrying sort, flat scatter with an
+    in-bounds trash cell -- 2-D index scatters are ~15x slower here).
 
     Args:
-        payload: int32[M] (must be >= 0 for valid messages; -1 is the wire
-            sentinel for an empty slot).
+        payloads: tuple of int32[M] (each >= 0 for valid messages; -1 is
+            the wire sentinel for an empty slot).
         dest_shard: int32[M] destination shard per message.
         valid: bool[M].
         n_shards: mesh size S.
         cap: per-destination-shard buffer slots.
 
     Returns:
-        recv: int32[S*cap] received payloads (-1 = empty slot).
+        recvs: tuple of int32[S*cap] received payloads (-1 = empty slot),
+            slot-aligned across payloads.
         overflow: int32[] messages dropped for capacity locally.
     """
     key = jnp.where(valid, dest_shard, n_shards).astype(I32)
-    order = jnp.argsort(key, stable=True)
-    sk = key[order]
-    sp = payload[order]
+    srt = jax.lax.sort((key, *[p.astype(I32) for p in payloads]),
+                       num_keys=1, is_stable=True)
+    sk, sps = srt[0], srt[1:]
     rank = segment_ranks(sk)
     ok = (sk < n_shards) & (rank < cap)
-    rows = jnp.where(ok, sk, n_shards)
-    cols = jnp.where(ok, rank, 0)
-    buf = jnp.full((n_shards, cap), -1, I32)
-    buf = buf.at[rows, cols].set(jnp.where(ok, sp, -1), mode="drop")
+    flat = jnp.where(ok, sk * cap + rank, n_shards * cap)  # trash cell
+    bufs = []
+    for sp in sps:
+        buf = jnp.full((n_shards * cap + 1,), -1, I32)
+        bufs.append(buf.at[flat].set(jnp.where(ok, sp, -1))
+                    [:n_shards * cap].reshape(n_shards, cap))
     overflow = ((sk < n_shards) & (rank >= cap)).sum(dtype=I32)
-    recv = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
-                              tiled=True)
-    return recv.reshape(-1), overflow
+    recv = jax.lax.all_to_all(jnp.concatenate(bufs, axis=1), axis,
+                              split_axis=0, concat_axis=0, tiled=True)
+    recvs = tuple(recv[:, i * cap:(i + 1) * cap].reshape(-1)
+                  for i in range(len(bufs)))
+    return recvs, overflow
+
+
+def route_one(payload: jnp.ndarray, dest_shard: jnp.ndarray,
+              valid: jnp.ndarray, n_shards: int, cap: int,
+              axis: str = AXIS):
+    """Exchange one int32 payload array (see route_multi)."""
+    (recv,), overflow = route_multi((payload,), dest_shard, valid, n_shards,
+                                    cap, axis)
+    return recv, overflow
 
 
 def epidemic_cap(n_local: int, k: int, n_shards: int, safety: int = 4) -> int:

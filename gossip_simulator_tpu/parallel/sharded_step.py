@@ -185,11 +185,11 @@ def make_sharded_pushpull(cfg: Config, mesh):
         req = (sus[:, None] & kept2 & ~crashed[:, None]).reshape(-1)
         tgt = peers2.reshape(-1)
         dest = jnp.where(req, tgt // n_local, s)
-        rtgt, ovf2 = exchange.route_one(jnp.where(req, tgt % n_local, -1),
-                                        dest, req, s, cap)
-        rreq, ovf3 = exchange.route_one(
-            jnp.where(req, jnp.broadcast_to(gids[:, None],
-                                            (n_local, f)).reshape(-1), -1),
+        # Target row and requester id share one sort + one all_to_all.
+        (rtgt, rreq), ovf2 = exchange.route_multi(
+            (jnp.where(req, tgt % n_local, -1),
+             jnp.where(req, jnp.broadcast_to(
+                 gids[:, None], (n_local, f)).reshape(-1), -1)),
             dest, req, s, cap)
         tvalid = rtgt >= 0
         tgt_idx = jnp.where(tvalid, rtgt, 0)
@@ -212,7 +212,7 @@ def make_sharded_pushpull(cfg: Config, mesh):
         received = st.received | newly
         dr = newly.sum(dtype=I32)
         dm, dr, dc = jax.lax.psum((dm, dr, dc), AXIS)
-        ovf = jax.lax.psum(ovf1 + ovf2 + ovf3 + ovf4, AXIS)
+        ovf = jax.lax.psum(ovf1 + ovf2 + ovf4, AXIS)
         return st._replace(
             received=received, crashed=crashed, tick=st.tick + 1,
             total_message=st.total_message + dm,
@@ -303,20 +303,18 @@ def make_sharded_overlay_round(cfg: Config, mesh):
     route_cap = exchange.epidemic_cap(n_local, cap + 2, s)
 
     def routed_deliver(src, dst, valid, mbox_cap):
-        """Route (src payload) to dst's shard, then local mailbox deliver."""
+        """Route (src payload) to dst's shard, then local mailbox deliver.
+        One route_multi call: src and the local-destination payload share
+        the sort and the all_to_all."""
         dest = jnp.where(valid, dst // n_local, s)
         dstl = jnp.where(valid, dst % n_local, 0)
-        rsrc, ovf1 = exchange.route_one(jnp.where(valid, src, -1), dest, valid,
-                                        s, route_cap, )
-        rdst, ovf2 = exchange.route_one(jnp.where(valid, dstl, -1), dest,
-                                        valid, s, route_cap)
+        (rsrc, rdst), ovf = exchange.route_multi(
+            (jnp.where(valid, src, -1), jnp.where(valid, dstl, -1)),
+            dest, valid, s, route_cap)
         rvalid = rsrc >= 0
         mbox, _, dropped = deliver(rsrc, jnp.where(rvalid, rdst, 0), rvalid,
                                    n_local, mbox_cap)
-        # ovf1 == ovf2 (identical dest/valid keys drive both routes); count
-        # each lost message once.
-        del ovf2
-        return mbox, dropped + ovf1
+        return mbox, dropped + ovf
 
     def ids_fn():
         shard = jax.lax.axis_index(AXIS)

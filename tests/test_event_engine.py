@@ -166,6 +166,10 @@ def test_event_overlay_handoff():
     res, _ = _run(engine="event", graph="overlay", n=1200, fanout=5,
                   seed=4, coverage_target=0.9)
     assert res.converged
+    # Regression: the driver's stabilization time must survive the
+    # overlay->epidemic state handoff (it read the fresh epidemic tick = 0
+    # before the _stabilize_ms snapshot existed).
+    assert res.stabilize_ms > 0
 
 
 def test_event_sharded_converges_and_matches_single_device():
