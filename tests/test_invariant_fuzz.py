@@ -45,6 +45,19 @@ def _random_cfg(i: int) -> Config:
 def test_counter_algebra_holds(i):
     cfg = _random_cfg(i)
     res = run_simulation(cfg, silent=True)
+    _check_algebra(cfg, res)
+
+
+@pytest.mark.parametrize("i", range(8, 12))
+def test_counter_algebra_holds_sharded(i):
+    cfg = _random_cfg(i)
+    n8 = -(-cfg.n // 8) * 8  # the 8-device mesh needs n % 8 == 0
+    cfg = cfg.replace(n=n8, backend="sharded").validate()
+    res = run_simulation(cfg, silent=True)
+    _check_algebra(cfg, res)
+
+
+def _check_algebra(cfg, res):
     st = res.stats
     n = cfg.n
     # Infection set and crash set are node sets.
