@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models.state import SimState
+from gossip_simulator_tpu.ops.select import first_true_indices  # noqa: F401  (re-export: compaction callers import it from here)
 from gossip_simulator_tpu.utils import rng as _rng
 
 I32 = jnp.int32
@@ -84,51 +85,6 @@ def row_slot(cfg: Config, delay_key, tick, rows):
     delay = _rng.row_uniform_delay(delay_key, cfg.delaylow, cfg.delayhigh,
                                    rows)
     return ((tick + delay) % d).astype(I32)
-
-
-def first_true_indices(mask: jnp.ndarray, cap: int,
-                       blk: int | None = None) -> jnp.ndarray:
-    """First <=cap indices of True in `mask`, ascending, padded with n.
-
-    Drop-in for ``jnp.nonzero(mask, size=cap, fill_value=n)[0]``, which XLA
-    lowers to a full-length cumsum + scatter (~150 ms at n=1e7 on TPU v5e --
-    the measured hot op of the compact tick).  Two-level version: one O(n)
-    block-count pass, a nonzero over the n/blk block counts, then gather +
-    in-block scan of only the first `cap` nonempty blocks.
-
-    Yield contract (what deposit_compact's fixed chunk count relies on):
-    if cap blocks are selected each holds >=1 True, and if every nonempty
-    block is selected (nb <= cap) all Trues are seen -- either way the call
-    yields min(cap, count) indices.
-
-    `blk` balances the two scans: the block-count nonzero touches n/blk
-    elements, the candidate gather touches min(nb, cap) * blk; blk ~
-    sqrt(n/cap) equalizes them (both ~sqrt(n*cap)), clamped to [8, 256].
-    """
-    n = mask.shape[0]
-    if n <= 4096 or cap >= n:
-        return jnp.nonzero(mask, size=cap, fill_value=n)[0].astype(I32)
-    if blk is None:
-        blk = 8
-        while blk * blk * cap < n and blk < 256:
-            blk *= 2
-    nb = -(-n // blk)
-    pad = nb * blk - n
-    m = jnp.pad(mask, (0, pad)) if pad else mask
-    m = m.reshape(nb, blk)
-    bc = m.sum(axis=1, dtype=I32)
-    capb = min(nb, cap)
-    bidx = jnp.nonzero(bc > 0, size=capb, fill_value=nb)[0].astype(I32)
-    rows = m.at[bidx].get(mode="fill", fill_value=False)
-    bcnt = bc.at[bidx].get(mode="fill", fill_value=0)
-    off = jnp.cumsum(bcnt) - bcnt  # exclusive: output offset of each block
-    local = jnp.cumsum(rows.astype(I32), axis=1) - 1
-    pos = off[:, None] + local
-    gidx = bidx[:, None] * blk + jnp.arange(blk, dtype=I32)[None, :]
-    take = rows & (pos < cap)
-    out = jnp.full((cap,), n, I32)
-    return out.at[jnp.where(take, pos, cap)].set(
-        jnp.where(take, gidx, n), mode="drop")
 
 
 def tick_keys(base_key: jax.Array, tick, shard: jax.Array | int | None = None):

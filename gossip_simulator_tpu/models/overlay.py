@@ -89,7 +89,11 @@ def make_round_fn(cfg: Config,
     em, eb = cap + 2, cap
     if deliver_fn is None:
         def deliver_fn(src, dst, valid, cap):
-            mbox, _, dropped = deliver(src, dst, valid, n, cap)
+            # Emission lists are mostly empty once membership settles:
+            # compact before the delivery sort (chunk ~n keeps the worst
+            # bootstrap round at ~2 passes).
+            mbox, _, dropped = deliver(src, dst, valid, n, cap,
+                                       compact_chunk=max(4096, n))
             return mbox, dropped
     if ids_fn is None:
         ids_fn = lambda: jnp.arange(n, dtype=I32)
