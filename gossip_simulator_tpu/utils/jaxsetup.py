@@ -22,3 +22,26 @@ def setup(cache_dir: str | None = None) -> None:
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+
+def forced_cpu_env(n_devices: int,
+                   base: dict[str, str] | None = None) -> dict[str, str]:
+    """Child-process env that forces an `n_devices`-way virtual CPU platform.
+
+    This image's sitecustomize registers the axon TPU PJRT plugin at
+    interpreter startup unless PALLAS_AXON_POOL_IPS is cleared, and with the
+    plugin registered JAX_PLATFORMS / --xla_force_host_platform_device_count
+    are no-ops -- so all three knobs must be set together, before the child's
+    first jax import.  Single source of truth for tests/conftest.py,
+    tests/test_distributed.py and __graft_entry__.dryrun_multichip.
+
+    Appending the device-count flag after any inherited value is safe: XLA
+    flag parsing is last-wins.
+    """
+    env = dict(os.environ if base is None else base)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon PJRT registration
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count={int(n_devices)}").strip()
+    return env

@@ -15,22 +15,16 @@ import sys
 
 
 def pytest_configure(config):
-    if os.environ.get("_GOSSIP_TEST_REEXEC") == "1":
-        from gossip_simulator_tpu.utils import jaxsetup
+    from gossip_simulator_tpu.utils import jaxsetup
 
+    if os.environ.get("_GOSSIP_TEST_REEXEC") == "1":
         jaxsetup.setup()
         return
     capman = config.pluginmanager.get_plugin("capturemanager")
     if capman is not None:
         capman.stop_global_capturing()
-    env = dict(os.environ)
+    env = jaxsetup.forced_cpu_env(8)
     env["_GOSSIP_TEST_REEXEC"] = "1"
-    env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon PJRT registration
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
     sys.stdout.flush()
     sys.stderr.flush()
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]],

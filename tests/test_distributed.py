@@ -8,7 +8,6 @@ in-process 8-device run the rest of the suite uses, and per-shard RNG
 streams depend only on shard index -- so the distributed totals must match
 the single-process totals EXACTLY."""
 
-import os
 import re
 import socket
 import subprocess
@@ -32,10 +31,9 @@ def _free_port() -> int:
 
 
 def _spawn(rank: int, port: int):
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU plugin in the children
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    from gossip_simulator_tpu.utils.jaxsetup import forced_cpu_env
+
+    env = forced_cpu_env(4)  # appended flag wins over the parent's 8
     cmd = [sys.executable, "-m", "gossip_simulator_tpu", *ARGS,
            "-distributed", "-coordinator", f"localhost:{port}",
            "-num-processes", "2", "-process-id", str(rank)]
