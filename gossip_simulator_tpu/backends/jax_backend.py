@@ -16,6 +16,7 @@ import numpy as np
 
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.models import epidemic, event, graphs, overlay
+from gossip_simulator_tpu.models.state import msg64_value
 from gossip_simulator_tpu.utils import rng as _rng
 from gossip_simulator_tpu.utils.metrics import Stats
 
@@ -116,7 +117,7 @@ class JaxStepper(Stepper):
              rem, st.tick, extra, event.in_flight(st)))
         return Stats(
             n=self.cfg.n, round=int(tick),
-            total_received=int(tr), total_message=int(tm),
+            total_received=int(tr), total_message=msg64_value(tm),
             total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
         ), int(in_flight)
@@ -233,6 +234,14 @@ class JaxStepper(Stepper):
                     f"checkpoint delay ring {tuple(tree['pending'].shape)} "
                     f"does not match this config's ({d}, {n}); restore with "
                     "the snapshot's -delaylow/-delayhigh/-time-mode")
+        tm = np.asarray(tree["total_message"])
+        if tm.ndim == 0:
+            # Pre-widening snapshot: scalar int32 counter -> [hi, lo] pair.
+            # & 0xFFFFFFFF also recovers a counter that had already wrapped
+            # negative (one int32 wrap reinterprets to the correct low word).
+            tree = dict(tree)
+            tree["total_message"] = np.asarray(
+                [0, int(tm) & 0xFFFFFFFF], dtype=np.uint32)
         cls = EventState if ckpt_engine == "event" else SimState
         self.state = cls(**{k: jax.numpy.asarray(v)
                             for k, v in tree.items()})

@@ -17,6 +17,7 @@ from gossip_simulator_tpu.models import epidemic, overlay
 from gossip_simulator_tpu.parallel import sharded_step
 from gossip_simulator_tpu.parallel.mesh import node_mesh, shard_size
 from gossip_simulator_tpu.utils import rng as _rng
+from gossip_simulator_tpu.models.state import msg64_value
 from gossip_simulator_tpu.utils.metrics import Stats
 
 
@@ -146,7 +147,7 @@ class ShardedStepper(Stepper):
              rem, st.exchange_overflow, st.tick, extra))
         return Stats(
             n=self.cfg.n, round=int(tick),
-            total_received=int(tr), total_message=int(tm),
+            total_received=int(tr), total_message=msg64_value(tm),
             total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
             exchange_overflow=int(xo),

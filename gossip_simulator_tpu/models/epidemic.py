@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
-from gossip_simulator_tpu.models.state import SimState
+from gossip_simulator_tpu.models.state import SimState, msg64_add, msg64_zero
 from gossip_simulator_tpu.ops.select import first_true_indices  # noqa: F401  (re-export: compaction callers import it from here)
 from gossip_simulator_tpu.utils import rng as _rng
 
@@ -68,7 +68,8 @@ def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
         friend_cnt=friend_cnt,
         pending=jnp.zeros((d, n), I32),
         rebroadcast=jnp.zeros((d_rb, n), bool),
-        tick=z(), total_message=z(), total_received=z(), total_crashed=z(),
+        tick=z(), total_message=msg64_zero(), total_received=z(),
+        total_crashed=z(),
         exchange_overflow=z(),
     )
 
@@ -279,7 +280,7 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
             pending = deposit_local(stp.pending, dst, slots, valid)
         return stp._replace(
             pending=pending,
-            total_message=stp.total_message + dm,
+            total_message=msg64_add(stp.total_message, dm),
             total_received=stp.total_received + dr,
             total_crashed=stp.total_crashed + dc)
 
@@ -361,7 +362,7 @@ def make_pushpull_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
         dst = jnp.where(edge, peers, n)
         arriving = jnp.zeros((n,), I32).at[dst].add(1, mode="drop")
         counted = jnp.where(live, arriving, 0)
-        total_message = st.total_message + counted.sum(dtype=I32)
+        total_message = msg64_add(st.total_message, counted.sum(dtype=I32))
         if crash_p > 0.0:
             pc = 1.0 - jnp.power(1.0 - crash_p, counted.astype(jnp.float32))
             new_crash = (jax.random.uniform(kc, (n,)) < pc) & (counted > 0)
@@ -379,7 +380,8 @@ def make_pushpull_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
         # crashed (st.crashed) matches the old two-gather form.
         peer_state = packed_peer_state(st.received, st.crashed)[peers2]
         pull_hit = (req & (peer_state == 1)).any(axis=1)
-        total_message = total_message + (req & (peer_state < 2)).sum(dtype=I32)
+        total_message = msg64_add(total_message,
+                                  (req & (peer_state < 2)).sum(dtype=I32))
 
         newly = (newly_push | pull_hit) & ~crashed & ~st.received
         received = st.received | newly

@@ -73,6 +73,7 @@ import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic
+from gossip_simulator_tpu.models.state import msg64_add, msg64_zero
 from gossip_simulator_tpu.utils import rng as _rng
 
 I32 = jnp.int32
@@ -108,7 +109,7 @@ class EventState(NamedTuple):
     # counts to (S, dw) under a P('nodes', None) spec.
     mail_cnt: jnp.ndarray  # int32[1, dw]
     tick: jnp.ndarray  # int32[]
-    total_message: jnp.ndarray  # int32[]
+    total_message: jnp.ndarray  # uint32[2] hi/lo 64-bit pair (state.msg64_*)
     total_received: jnp.ndarray  # int32[]
     total_crashed: jnp.ndarray  # int32[]
     mail_dropped: jnp.ndarray  # int32[]  slot-capacity overflow (counted)
@@ -200,7 +201,8 @@ def init_state(cfg: Config, friends: jnp.ndarray,
             (ring_windows(cfg) * slot_cap(cfg, n) + drain_chunk(cfg, n),),
             I32),
         mail_cnt=jnp.zeros((1, ring_windows(cfg)), I32),
-        tick=z(), total_message=z(), total_received=z(), total_crashed=z(),
+        tick=z(), total_message=msg64_zero(), total_received=z(),
+        total_crashed=z(),
         mail_dropped=z(), exchange_overflow=z(),
     )
 
@@ -454,7 +456,7 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
         return st._replace(
             flags=flags, mail_ids=mail_ids,
             mail_cnt=mail_cnt, tick=st.tick + b,
-            total_message=st.total_message + dm,
+            total_message=msg64_add(st.total_message, dm),
             total_received=st.total_received + dr,
             total_crashed=st.total_crashed + dc,
             mail_dropped=dropped)

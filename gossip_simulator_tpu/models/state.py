@@ -2,16 +2,54 @@
 
 The reference's per-node ``Node`` struct (simulator.go:34-46) becomes one
 struct-of-arrays over the node axis; every field shards trivially on that
-axis for the sharded backend.  Counters live on device (int32 -- safe to
-~350M nodes at fanout 5; the reference's int32 atomics have the same bound,
-SURVEY §5.5) and are fetched once per progress window.
+axis for the sharded backend.  Counters live on device and are fetched once
+per progress window.
+
+Counter widths (SURVEY §5.5 prescribes int64 where the reference's int32
+atomics can overflow, simulator.go:26-31): ``total_received`` /
+``total_crashed`` are bounded by n (int32 is safe to n = 2^31), but
+``total_message`` counts every delivery and SIR re-broadcasts indefinitely --
+at n = 1e8 it crosses 2^31 within a few hundred simulated seconds.  It is
+therefore a 64-bit counter, represented as a uint32 ``[hi, lo]`` pair
+(``msg64_*`` helpers below) rather than a jnp.int64 scalar: enabling
+jax_enable_x64 globally would flip every unannotated jax.random draw to
+float64/int64, changing the bit-exact RNG streams the parity tests pin and
+dragging emulated-f64 ops onto the TPU hot path.  The pair costs three
+scalar ops per accumulation and nothing else.
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple
 
+import numpy as np
+
 import jax.numpy as jnp
+
+
+def msg64_zero() -> jnp.ndarray:
+    """Device-side 64-bit counter: uint32 [hi, lo] = 0."""
+    return jnp.zeros((2,), jnp.uint32)
+
+
+def msg64_add(c: jnp.ndarray, delta) -> jnp.ndarray:
+    """c + delta with carry.  `delta` is a nonnegative int32/uint32 scalar
+    (per-tick/per-window deltas are bounded by the mail-ring / delay-ring
+    per-slot capacities, all sized below 2^31 entries)."""
+    d = delta.astype(jnp.uint32)
+    lo = c[1] + d
+    carry = (lo < d).astype(jnp.uint32)  # uint32 add wraps iff result < d
+    return jnp.stack([c[0] + carry, lo])
+
+
+def msg64_value(c) -> int:
+    """Host-side Python int from a fetched [hi, lo] pair (also accepts a
+    legacy scalar from pre-widening checkpoints)."""
+    a = np.asarray(c)
+    if a.ndim == 0:
+        return int(a)
+    a = a.astype(np.uint64)
+    return int((a[0] << np.uint64(32)) | a[1])
 
 
 class SimState(NamedTuple):
@@ -26,7 +64,7 @@ class SimState(NamedTuple):
     pending: jnp.ndarray  # int32[d, n]  arrival counts, ring over ticks
     rebroadcast: jnp.ndarray  # bool[d, n]  SIR re-broadcast schedule
     tick: jnp.ndarray  # int32[]
-    total_message: jnp.ndarray  # int32[]  (simulator.go:31)
+    total_message: jnp.ndarray  # uint32[2] hi/lo 64-bit pair (simulator.go:31)
     total_received: jnp.ndarray  # int32[]  (simulator.go:29)
     total_crashed: jnp.ndarray  # int32[]  (simulator.go:30)
     # Framework-only: cross-shard all_to_all bucket overflow (0 on one chip;

@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic, event, graphs
 from gossip_simulator_tpu.models.event import EventState
+from gossip_simulator_tpu.models.state import msg64_add
 from gossip_simulator_tpu.parallel import exchange
 from gossip_simulator_tpu.parallel.mesh import AXIS, shard_size
 from gossip_simulator_tpu.utils import rng as _rng
@@ -248,7 +249,7 @@ def make_sharded_event_step(cfg: Config, mesh):
         return st._replace(
             flags=flags, mail_ids=mail, mail_cnt=cnt,
             tick=st.tick + b,
-            total_message=st.total_message + dm,
+            total_message=msg64_add(st.total_message, dm),
             total_received=st.total_received + dr,
             total_crashed=st.total_crashed + dc,
             mail_dropped=st.mail_dropped + ddrop,

@@ -24,7 +24,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic, graphs, overlay
-from gossip_simulator_tpu.models.state import OverlayState, SimState
+from gossip_simulator_tpu.models.state import (OverlayState, SimState,
+                                               msg64_add)
 from gossip_simulator_tpu.ops.mailbox import deliver
 from gossip_simulator_tpu.parallel import exchange
 from gossip_simulator_tpu.parallel.mesh import AXIS, shard_size
@@ -123,9 +124,11 @@ def make_sharded_tick(cfg: Config, mesh):
         dm, dr, dc, ovf = jax.lax.psum((dm, dr, dc, ovf), AXIS)
         # NOTE: no lax.cond empty-slot skip here -- see the miscompile note
         # in epidemic.make_tick_fn (axon platform, cond + dynamic fori).
+        # The psum'd per-tick delta stays int32 (bounded by the delay-ring
+        # capacity); the carry into the 64-bit pair is replicated per shard.
         return stp._replace(
             pending=pending,
-            total_message=stp.total_message + dm,
+            total_message=msg64_add(stp.total_message, dm),
             total_received=stp.total_received + dr,
             total_crashed=stp.total_crashed + dc,
             exchange_overflow=stp.exchange_overflow + ovf)
@@ -215,7 +218,7 @@ def make_sharded_pushpull(cfg: Config, mesh):
         ovf = jax.lax.psum(ovf1 + ovf2 + ovf4, AXIS)
         return st._replace(
             received=received, crashed=crashed, tick=st.tick + 1,
-            total_message=st.total_message + dm,
+            total_message=msg64_add(st.total_message, dm),
             total_received=st.total_received + dr,
             total_crashed=st.total_crashed + dc,
             exchange_overflow=st.exchange_overflow + ovf)
