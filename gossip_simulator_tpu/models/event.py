@@ -541,18 +541,22 @@ def make_window_fn(cfg: Config, window: int):
     return window_fn
 
 
+def poll_window_steps(cfg: Config) -> int:
+    """B-tick steps per 10 ms poll window: the cadence every fast-path run
+    cond must check at so it reports the same death tick / totals as the
+    windowed driver loop (with B < 10 a per-step check stops earlier).  The
+    10 is base.WINDOW_MS, hardcoded to keep models/ free of backends/
+    imports.  Shared by this engine's run fn and the sharded one
+    (parallel/event_sharded.make_run_to_coverage_fn)."""
+    return max(1, -(-10 // batch_ticks(cfg)))
+
+
 def make_run_to_coverage_fn(cfg: Config):
     """Bounded device-side while_loop, same contract as the ring engine's
     (epidemic.make_run_to_coverage_fn / base.run_bounded_to_target)."""
     step = make_window_step_fn(cfg)
     max_steps = cfg.max_rounds
-    # One while iteration advances a full 10 ms poll window (ceil(10/B)
-    # B-tick steps), the SAME cadence the windowed driver path checks at --
-    # with B < 10 a per-step check would stop earlier and report different
-    # totals for the same config depending on the observation mode.  (10 =
-    # base.WINDOW_MS, hardcoded like the ring engine's run fn to keep
-    # models/ free of backends/ imports.)
-    steps = max(1, -(-10 // batch_ticks(cfg)))
+    steps = poll_window_steps(cfg)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run_fn(st: EventState, base_key: jax.Array, target_count: jax.Array,

@@ -346,17 +346,29 @@ def make_run_to_coverage_fn(cfg: Config, mesh):
     step = make_sharded_event_step(cfg, mesh)
     specs = event_state_specs()
     max_steps = cfg.max_rounds
+    # One while iteration = one full 10 ms poll window, the cadence the
+    # windowed driver path observes at (see event.poll_window_steps).
+    steps = event.poll_window_steps(cfg)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run(st: EventState, base_key: jax.Array, target_count: jax.Array,
             until: jax.Array) -> EventState:
         def run_shard(st, base_key, target_count, until):
             def cond(s):
+                # The in-flight term (psum of each shard's slot counts --
+                # replicated, so every shard agrees) stops the loop the
+                # moment the wave dies instead of spinning empty windows
+                # until the host-side bounded-call check notices, matching
+                # the single-device cond (event.make_run_to_coverage_fn).
                 return ((s.total_received < target_count)
-                        & (s.tick < max_steps) & (s.tick < until))
+                        & (s.tick < max_steps) & (s.tick < until)
+                        & (jax.lax.psum(s.mail_cnt.sum(), AXIS) > 0))
 
-            return jax.lax.while_loop(
-                cond, lambda s: step(s, base_key), st)
+            def body(s):
+                return jax.lax.fori_loop(
+                    0, steps, lambda _, x: step(x, base_key), s)
+
+            return jax.lax.while_loop(cond, body, st)
 
         return _shard_map(mesh, run_shard, in_specs=(specs, P(), P(), P()),
                           out_specs=specs)(st, base_key, target_count, until)

@@ -217,6 +217,44 @@ def test_event_sharded_run_to_target_matches_windows():
     assert fast.total_received == res.stats.total_received
 
 
+def test_event_sharded_exhaustion_exits_device_loop():
+    """A dead wave on the sharded event path must exit the device-side
+    while_loop at wave death (psum'd in-flight term in the run cond,
+    matching the single-device engine), not spin empty windows until the
+    bounded-call budget (~1024 ticks) lets the host notice."""
+    from gossip_simulator_tpu.backends.sharded import ShardedStepper
+
+    cfg = Config(**{**BASE, "backend": "sharded", "n": 4000,
+                    "droprate": 1.0, "max_rounds": 50_000}).validate()
+    s = ShardedStepper(cfg)
+    s.init()
+    s.seed()
+    st = s.run_to_target()
+    assert s.exhausted
+    assert st.total_received <= 1  # the seed's self-mark only
+    assert st.round <= 20  # exited at wave death, not at the call budget
+
+
+def test_event_sharded_exhaustion_tick_matches_windowed():
+    """Die-out config (fanout 1, drop 0.3 is subcritical): the fast path's
+    death tick must equal the windowed loop's, since both observe the empty
+    ring at the same 10 ms cadence."""
+    kw = dict(backend="sharded", n=4000, fanout=1, droprate=0.3,
+              max_rounds=50_000)
+    from gossip_simulator_tpu.backends.sharded import ShardedStepper
+
+    cfg = Config(**{**BASE, **kw}).validate()
+    s = ShardedStepper(cfg)
+    s.init()
+    s.seed()
+    fast = s.run_to_target()
+    res, _ = _run_windowed(**kw)
+    assert not res.converged
+    assert fast.round == res.stats.round
+    assert fast.round < cfg.max_rounds
+    assert fast.total_message == res.stats.total_message
+
+
 def test_event_sir_removal_one_matches_si():
     """removal_rate=1: every sender broadcasts exactly once then stops --
     the SIR wave degenerates to SI.  Drop/delay streams are row-keyed and
