@@ -49,7 +49,7 @@ def _build_lib() -> str:
 _lib = None
 
 
-ABI_VERSION = 2  # must match sim_abi_version() in gossip_sim.cpp
+ABI_VERSION = 3  # must match sim_abi_version() in gossip_sim.cpp
 
 
 def load_lib():
@@ -81,8 +81,11 @@ def load_lib():
             ctypes.POINTER(ctypes.c_int32)]
         lib.sim_seed.argtypes = [ctypes.c_void_p]
         lib.sim_gossip_window.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        # v3: the caller passes its buffer length, so ABI growth can never
+        # overrun an older caller's buffer (nor an older library a newer's).
         lib.sim_stats.argtypes = [ctypes.c_void_p,
-                                  ctypes.POINTER(ctypes.c_int64)]
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_int32]
         lib.sim_now.restype = ctypes.c_double
         lib.sim_now.argtypes = [ctypes.c_void_p]
         lib.sim_phase_start.restype = ctypes.c_double
@@ -133,7 +136,7 @@ class CppStepper(Stepper):
 
     def stats(self) -> Stats:
         buf = (ctypes.c_int64 * 7)()
-        self._lib.sim_stats(self._h, buf)
+        self._lib.sim_stats(self._h, buf, 7)
         self._exhausted = bool(buf[5]) and self.cfg.protocol != "pushpull"
         return Stats(
             n=self.cfg.n,

@@ -227,6 +227,15 @@ class JaxStepper(Stepper):
                     np.asarray(tree["mail_cnt"]), ncap)
                 tree["mail_dropped"] = np.asarray(
                     tree["mail_dropped"]) + np.int32(lost)
+            elif tuple(tree["mail_ids"].shape) != want_mail:
+                # Geometry matches the config but the array itself is
+                # truncated/corrupt: fail here with a clear error instead of
+                # letting the drain's dynamic_slice mis-index at runtime.
+                raise ValueError(
+                    f"checkpoint mail_ids length "
+                    f"{tree['mail_ids'].shape[0]} contradicts its geometry "
+                    f"(cap={ncap}, chunk={nchunk} => {want_mail[0]}); the "
+                    "snapshot is truncated or corrupt")
         else:
             d = epidemic.ring_depth(cfg)
             if tuple(tree["pending"].shape) != (d, n):

@@ -288,7 +288,9 @@ extern "C" {
 // Bump when the C ABI changes (slots in sim_stats etc.); cpp.py checks it
 // so a stale prebuilt library cannot silently misreport new fields.
 // v2: sim_stats gained out[6] = SIR removed count.
-int32_t sim_abi_version() { return 2; }
+// v3: sim_stats takes n_slots (caller buffer length) and writes at most
+//     min(n_slots, 7) entries, so future slot growth is skew-safe.
+int32_t sim_abi_version() { return 3; }
 
 void* sim_create(int64_t n, int32_t fanout, int32_t fanin, int32_t delaylow,
                  int32_t delayhigh, double droprate, double crashrate,
@@ -315,21 +317,27 @@ void sim_gossip_window(void* h, double win) {
   static_cast<Sim*>(h)->gossip_window(win);
 }
 
-void sim_stats(void* h, int64_t* out) {
+void sim_stats(void* h, int64_t* out, int32_t n_slots) {
+  // The caller passes its buffer length so ABI growth is safe in both
+  // skew directions: an old caller's short buffer is never overrun, and a
+  // new caller of an old library fails the version gate instead.
   Sim* s = static_cast<Sim*>(h);
-  out[0] = s->total_received;
-  out[1] = s->total_message;
-  out[2] = s->total_crashed;
-  out[3] = s->makeups;
-  out[4] = s->breakups;
-  out[5] = s->exhausted ? 1 : 0;
+  int64_t vals[7];
+  vals[0] = s->total_received;
+  vals[1] = s->total_message;
+  vals[2] = s->total_crashed;
+  vals[3] = s->makeups;
+  vals[4] = s->breakups;
+  vals[5] = s->exhausted ? 1 : 0;
   // SIR only: removed[] is provably all-zero otherwise and this scan is
   // inside the benchmarked polling path.
   int64_t rem = 0;
   if (s->p.protocol == SIR) {
     for (uint8_t r : s->removed) rem += r;
   }
-  out[6] = rem;
+  vals[6] = rem;
+  int32_t k = n_slots < 7 ? n_slots : 7;
+  for (int32_t i = 0; i < k; ++i) out[i] = vals[i];
 }
 
 double sim_now(void* h) { return static_cast<Sim*>(h)->now; }

@@ -296,6 +296,13 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     adds = (w * ok[:, None]).sum(axis=0)
     new_cnt = mail_cnt + adds[None, :]
     lost = (edge & ~ok[:, None]).sum(dtype=I32)
+    # CAVEAT (SIR): an overflowed reservation loses the sender's
+    # re-broadcast trigger along with its data messages, permanently muting
+    # that node's re-broadcast chain -- a qualitatively larger distortion
+    # than the per-message count suggests.  slot_cap budgets mean_degree+1
+    # per sender precisely so this stays at zero; a nonzero mail_dropped
+    # under SIR should be treated as an undersized -event-slot-cap, not as
+    # ordinary message loss (see README divergence table).
     return mail_ids, new_cnt, dropped + lost
 
 
@@ -562,13 +569,13 @@ def make_run_to_coverage_fn(cfg: Config):
     def run_fn(st: EventState, base_key: jax.Array, target_count: jax.Array,
                until: jax.Array) -> EventState:
         def cond(s: EventState):
-            # The in-flight term (a dw-element sum -- free) stops the loop
-            # the moment the wave dies instead of spinning empty windows to
-            # max_rounds (the host-side exhaustion check only runs between
-            # bounded calls).
+            # The in-flight term (a dw-element emptiness test -- free) stops
+            # the loop the moment the wave dies instead of spinning empty
+            # windows to max_rounds (the host-side exhaustion check only
+            # runs between bounded calls).
             return ((s.total_received < target_count)
                     & (s.tick < max_steps) & (s.tick < until)
-                    & (s.mail_cnt.sum() > 0))
+                    & jnp.any(s.mail_cnt > 0))
 
         def body(s: EventState):
             return jax.lax.fori_loop(
@@ -580,11 +587,14 @@ def make_run_to_coverage_fn(cfg: Config):
 
 
 def in_flight(st) -> jnp.ndarray:
-    """Messages still undelivered -- engine-agnostic (EventState or the ring
-    engine's SimState)."""
+    """int32 0/1: nonzero iff any message is still undelivered --
+    engine-agnostic (EventState or the ring engine's SimState).  An
+    indicator, NOT a count: every caller only tests emptiness, and a full
+    count would overflow int32 when summed across shards near ring
+    occupancy (slot_cap clamps each shard to ~2^31 entries)."""
     if hasattr(st, "mail_cnt"):
-        return st.mail_cnt.sum()
-    return st.pending.sum() + st.rebroadcast.sum()
+        return jnp.any(st.mail_cnt > 0).astype(I32)
+    return (jnp.any(st.pending > 0) | jnp.any(st.rebroadcast)).astype(I32)
 
 
 def removed_count(st) -> jnp.ndarray:

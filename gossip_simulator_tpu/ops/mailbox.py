@@ -17,10 +17,14 @@ same `deliver` runs per shard after messages are routed with all_to_all
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 
 from gossip_simulator_tpu.ops.select import first_true_indices
+
+_warned_dense_fallback = False
 
 
 def segment_ranks(sorted_keys: jnp.ndarray) -> jnp.ndarray:
@@ -73,9 +77,22 @@ def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
     avoids relying on the OOB-drop semantics that were miscompiled there).
     """
     m = src.shape[0]
-    if (compact_chunk is not None and compact_chunk < m
-            and (n + 1) * cap < 2**31):
-        return _deliver_compact(src, dst, valid, n, cap, compact_chunk)
+    if compact_chunk is not None and compact_chunk < m:
+        if (n + 1) * cap < 2**31:
+            return _deliver_compact(src, dst, valid, n, cap, compact_chunk)
+        # Flat int32 addressing no longer fits: the requested compaction is
+        # ignored and the full-length sort + 2-D scatter path below runs
+        # (~15x slower per the NOTE).  Without a signal this reads as an
+        # unexplained performance cliff at n >= ~1.35e8, so say it once.
+        global _warned_dense_fallback
+        if not _warned_dense_fallback:
+            _warned_dense_fallback = True
+            warnings.warn(
+                f"mailbox.deliver: (n+1)*cap = {(n + 1) * cap} >= 2^31 -- "
+                "compact_chunk is ignored and overlay delivery falls back "
+                "to the dense sort + 2-D scatter path (~15x slower); "
+                "reduce -mailbox-cap or shard the node axis",
+                stacklevel=2)
     key = jnp.where(valid, dst, n).astype(jnp.int32)
     sd, ss = jax.lax.sort((key, src.astype(jnp.int32)), num_keys=1,
                           is_stable=True)

@@ -355,14 +355,18 @@ def make_run_to_coverage_fn(cfg: Config, mesh):
             until: jax.Array) -> EventState:
         def run_shard(st, base_key, target_count, until):
             def cond(s):
-                # The in-flight term (psum of each shard's slot counts --
-                # replicated, so every shard agrees) stops the loop the
-                # moment the wave dies instead of spinning empty windows
-                # until the host-side bounded-call check notices, matching
-                # the single-device cond (event.make_run_to_coverage_fn).
+                # The in-flight term (psum of each shard's ring-occupied
+                # indicator -- replicated, so every shard agrees) stops the
+                # loop the moment the wave dies instead of spinning empty
+                # windows until the host-side bounded-call check notices,
+                # matching the single-device cond
+                # (event.make_run_to_coverage_fn).  Indicator, not count:
+                # a cross-shard sum of entry counts could wrap int32 near
+                # ring occupancy.
+                occupied = jnp.any(s.mail_cnt > 0).astype(jnp.int32)
                 return ((s.total_received < target_count)
                         & (s.tick < max_steps) & (s.tick < until)
-                        & (jax.lax.psum(s.mail_cnt.sum(), AXIS) > 0))
+                        & (jax.lax.psum(occupied, AXIS) > 0))
 
             def body(s):
                 return jax.lax.fori_loop(

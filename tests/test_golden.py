@@ -1,0 +1,48 @@
+"""Golden-output compat tests: the full stdout of tiny -compat-reference
+runs, byte-exact against checked-in transcripts.
+
+Pins the complete observable surface of SURVEY §0's output contract in one
+place: the alphabetical parameter dump with ms suffixes (simulator.go:
+197-204), the `elasped` typo windows (230), the stabilize/99% summaries with
+Go-style duration rendering -- `280ms` vs `7.12s` (235, 252; metrics.
+fmt_sim_ms), and the final totals line (253) with Total Crashed 0 under the
+compat 1%-resolution truncation.  Regenerate with the commands in each
+golden file's test after an INTENTIONAL format change; any other diff is a
+regression.
+"""
+
+import os
+import subprocess
+import sys
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*args: str) -> str:
+    proc = subprocess.run(
+        [sys.executable, "-m", "gossip_simulator_tpu", *args],
+        cwd=REPO, env=dict(os.environ), text=True,
+        capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def _golden(name: str) -> str:
+    with open(os.path.join(GOLDEN, name)) as f:
+        return f.read()
+
+
+def test_compat_reference_small_byte_exact():
+    out = _run_cli("-n", "800", "-backend", "native", "-seed", "7",
+                   "-compat-reference")
+    assert out == _golden("compat_small.txt")
+
+
+def test_compat_reference_seconds_rendering_byte_exact():
+    """Delays in the hundreds of ms push both phase summaries past 1s,
+    pinning the s-unit rendering (`7.12s`, `4s`) alongside ms."""
+    out = _run_cli("-n", "400", "-backend", "native", "-seed", "11",
+                   "-compat-reference", "-delaylow", "500",
+                   "-delayhigh", "1000", "-quiet")
+    assert out == _golden("compat_seconds.txt")
