@@ -147,111 +147,23 @@ class JaxStepper(Stepper):
             tree["mail_geom"] = np.asarray(
                 [event.slot_cap(cfg, n), event.drain_chunk(cfg, n)],
                 dtype=np.int64)
+        # Phase-1 overlay drops live host-side, not in the device state --
+        # persist them or a resumed run under-reports mailbox_dropped.
+        tree["host_mailbox_dropped"] = np.int64(self._mailbox_dropped)
         return tree
 
     def load_state_pytree(self, tree) -> None:
+        """Restore a snapshot (validation, legacy coercion and mail-ring
+        geometry repack shared with the sharded backend:
+        utils/checkpoint.prepare_restore_tree)."""
         from gossip_simulator_tpu.models.event import EventState
         from gossip_simulator_tpu.models.state import SimState
+        from gossip_simulator_tpu.utils.checkpoint import prepare_restore_tree
 
         cfg = self.cfg
-        ckpt_engine = "event" if "mail_ids" in tree else "ring"
-        if ckpt_engine != cfg.engine_resolved:
-            raise ValueError(
-                f"checkpoint was written by the {ckpt_engine} engine but "
-                f"this run resolves to {cfg.engine_resolved}; pass "
-                f"-engine {ckpt_engine} to restore it")
-        if ckpt_engine == "event" and "received" in tree:
-            # Pre-packed-flags event snapshot: fold the two bool arrays into
-            # the uint8 flags layout (bit0 received, bit1 crashed).
-            tree = dict(tree)
-            tree["flags"] = (
-                tree.pop("received").astype(np.uint8)
-                + tree.pop("crashed").astype(np.uint8) * 2)
-        # Geometry check: ring layouts are decoded from cfg-derived constants
-        # (cap, dw, delay depth), so a snapshot written under different
-        # -n/-delayhigh/-event-* flags would silently mis-index.
-        n = int(tree["flags" if ckpt_engine == "event"
-                     else "received"].shape[0])
-        if n != cfg.n:
-            raise ValueError(
-                f"checkpoint has n={n} but this run has n={cfg.n}")
-        if ckpt_engine == "event":
-            dw = event.ring_windows(cfg)
-            ncap = event.slot_cap(cfg, n)
-            nchunk = event.drain_chunk(cfg, n)
-            want_mail = (dw * ncap + nchunk,)
-            tree = dict(tree)
-            geom = tree.pop("mail_geom", None)
-            if tuple(tree["mail_cnt"].shape) != (1, dw):
-                raise ValueError(
-                    "checkpoint window-ring depth "
-                    f"{tuple(tree['mail_cnt'].shape)} does not match this "
-                    f"config's (1, {dw}); restore with the snapshot's "
-                    "-delaylow/-delayhigh")
-            # Compare the STORED geometry, not just array length: distinct
-            # (cap, chunk) pairs can have equal dw*cap+chunk totals, which
-            # would mis-index every slot base if accepted as-is.
-            drifted = ((int(geom[0]), int(geom[1])) != (ncap, nchunk)
-                       if geom is not None
-                       else tuple(tree["mail_ids"].shape) != want_mail)
-            if drifted:
-                # Geometry drifted (different -event-* flags, or a build
-                # whose auto sizing changed).  Repack slot-by-slot using the
-                # stored geometry; legacy snapshots without mail_geom can't
-                # be repacked safely, so keep the strict error there.
-                if geom is None:
-                    raise ValueError(
-                        "checkpoint mail-ring geometry "
-                        f"{tuple(tree['mail_ids'].shape)} does not match "
-                        f"this config's {want_mail} and the snapshot "
-                        "predates geometry metadata; restore with the same "
-                        "-delaylow/-delayhigh/-event-slot-cap/-event-chunk "
-                        "it was written with")
-                ocap, ochunk = int(geom[0]), int(geom[1])
-                if tree["mail_ids"].shape[0] != dw * ocap + ochunk:
-                    raise ValueError(
-                        f"checkpoint mail_ids length "
-                        f"{tree['mail_ids'].shape[0]} contradicts its "
-                        f"stored geometry (cap={ocap}, chunk={ochunk})")
-                old = np.asarray(tree["mail_ids"])
-                cnt = np.asarray(tree["mail_cnt"])[0]
-                new = np.zeros(want_mail, old.dtype)
-                lost = 0
-                for s in range(dw):
-                    take = min(int(cnt[s]), ncap)
-                    lost += int(cnt[s]) - take
-                    new[s * ncap:s * ncap + take] = \
-                        old[s * ocap:s * ocap + take]
-                tree["mail_ids"] = new
-                tree["mail_cnt"] = np.minimum(
-                    np.asarray(tree["mail_cnt"]), ncap)
-                tree["mail_dropped"] = np.asarray(
-                    tree["mail_dropped"]) + np.int32(lost)
-            elif tuple(tree["mail_ids"].shape) != want_mail:
-                # Geometry matches the config but the array itself is
-                # truncated/corrupt: fail here with a clear error instead of
-                # letting the drain's dynamic_slice mis-index at runtime.
-                raise ValueError(
-                    f"checkpoint mail_ids length "
-                    f"{tree['mail_ids'].shape[0]} contradicts its geometry "
-                    f"(cap={ncap}, chunk={nchunk} => {want_mail[0]}); the "
-                    "snapshot is truncated or corrupt")
-        else:
-            d = epidemic.ring_depth(cfg)
-            if tuple(tree["pending"].shape) != (d, n):
-                raise ValueError(
-                    f"checkpoint delay ring {tuple(tree['pending'].shape)} "
-                    f"does not match this config's ({d}, {n}); restore with "
-                    "the snapshot's -delaylow/-delayhigh/-time-mode")
-        tm = np.asarray(tree["total_message"])
-        if tm.ndim == 0:
-            # Pre-widening snapshot: scalar int32 counter -> [hi, lo] pair.
-            # & 0xFFFFFFFF also recovers a counter that had already wrapped
-            # negative (one int32 wrap reinterprets to the correct low word).
-            tree = dict(tree)
-            tree["total_message"] = np.asarray(
-                [0, int(tm) & 0xFFFFFFFF], dtype=np.uint32)
-        cls = EventState if ckpt_engine == "event" else SimState
+        tree = prepare_restore_tree(tree, cfg, n_shards=1)
+        self._mailbox_dropped = int(tree.pop("host_mailbox_dropped", 0))
+        cls = EventState if cfg.engine_resolved == "event" else SimState
         self.state = cls(**{k: jax.numpy.asarray(v)
                             for k, v in tree.items()})
         self._overlay_done = True

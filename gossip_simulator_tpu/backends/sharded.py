@@ -15,7 +15,7 @@ import jax
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.models import epidemic, overlay
 from gossip_simulator_tpu.parallel import sharded_step
-from gossip_simulator_tpu.parallel.mesh import node_mesh, shard_size
+from gossip_simulator_tpu.parallel.mesh import AXIS, node_mesh, shard_size
 from gossip_simulator_tpu.utils import rng as _rng
 from gossip_simulator_tpu.models.state import msg64_value
 from gossip_simulator_tpu.utils.metrics import Stats
@@ -55,7 +55,12 @@ class ShardedStepper(Stepper):
             self._run_fn = sharded_step.make_run_to_coverage_fn(
                 cfg, self.mesh)
             init_fn = sharded_step.make_sharded_init
-        if cfg.graph == "overlay":
+        if cfg.resume:
+            # State arrives via load_state_pytree; building a sharded graph
+            # here would be thrown away (see JaxStepper.init).
+            self.state = None
+            self._overlay_done = True
+        elif cfg.graph == "overlay":
             self._oround = sharded_step.make_overlay_round_fn(cfg, self.mesh)
             self.ostate = sharded_step.make_sharded_overlay_init(
                 cfg, self.mesh)()
@@ -162,6 +167,50 @@ class ShardedStepper(Stepper):
         return float(jax.device_get(self.state.tick))
 
     def state_pytree(self):
+        """Host-gathered snapshot (np.asarray collects all shards).  The
+        event mail ring is S per-shard rings concatenated, so mail_geom
+        records the PER-SHARD slot geometry plus the shard count -- a
+        snapshot restores onto the same device count only (the ring engine's
+        state is layout-independent and restores onto any mesh)."""
         if self.state is None:
             return None
-        return {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        tree = {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        if "mail_ids" in tree:
+            from gossip_simulator_tpu.models import event
+
+            cfg = self.cfg
+            n_local = shard_size(cfg.n, self.mesh)
+            tree["mail_geom"] = np.asarray(
+                [event.slot_cap(cfg, n_local), event.drain_chunk(cfg, n_local),
+                 self.mesh.shape[AXIS]], dtype=np.int64)
+        # Phase-1 overlay drops live host-side, not in the device state --
+        # persist them or a resumed run under-reports mailbox_dropped.
+        tree["host_mailbox_dropped"] = np.int64(self._mailbox_dropped)
+        return tree
+
+    def load_state_pytree(self, tree) -> None:
+        """Restore a snapshot onto the mesh (validation, legacy coercion
+        and per-shard mail-ring repack shared with the single-device
+        backend: utils/checkpoint.prepare_restore_tree), then device_put
+        every leaf with its partition spec -- the restored run's trajectory
+        is identical to the uninterrupted one (step keys depend only on
+        (seed, tick, shard))."""
+        from jax.sharding import NamedSharding
+
+        from gossip_simulator_tpu.models.event import EventState
+        from gossip_simulator_tpu.models.state import SimState
+        from gossip_simulator_tpu.parallel import event_sharded
+        from gossip_simulator_tpu.utils.checkpoint import prepare_restore_tree
+
+        cfg, mesh = self.cfg, self.mesh
+        tree = prepare_restore_tree(tree, cfg, n_shards=mesh.shape[AXIS])
+        self._mailbox_dropped = int(tree.pop("host_mailbox_dropped", 0))
+        if cfg.engine_resolved == "event":
+            cls, specs = EventState, event_sharded.event_state_specs()
+        else:
+            cls, specs = SimState, sharded_step.sim_state_specs()
+        self.state = cls(**{
+            k: jax.device_put(v, NamedSharding(mesh, getattr(specs, k)))
+            for k, v in tree.items()})
+        self._overlay_done = True
+        self._seeded = True  # snapshots are taken mid-phase-2

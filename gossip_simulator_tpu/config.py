@@ -289,8 +289,9 @@ class Config:
         if self.resume:
             if not self.checkpoint_dir:
                 raise ValueError("-resume requires -checkpoint-dir")
-            if self.backend != "jax":
-                raise ValueError("-resume currently requires backend=jax")
+            if self.backend not in ("jax", "sharded"):
+                raise ValueError(
+                    "-resume requires backend=jax or sharded")
         if self.fanout >= self.n:
             raise ValueError(f"fanout ({self.fanout}) must be < n ({self.n})")
         return self

@@ -41,3 +41,128 @@ def load(path: str) -> tuple[dict[str, np.ndarray], dict]:
         with open(path + ".json") as f:
             meta = json.load(f)
     return arrays, meta
+
+
+def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
+    """Shared snapshot validation + coercion for the jax and sharded
+    backends' ``load_state_pytree``: engine gate, n check, legacy-field
+    coercion (pre-packed-flags event snapshots, pre-widening scalar
+    total_message), and the event mail-ring geometry check with per-shard
+    slot repack on drift.  Returns a new dict of host arrays ready for
+    device placement; raises ValueError with a restore-specific message on
+    any mismatch.  ``n_shards`` is 1 for the single-device backend; the
+    event ring is ``n_shards`` per-shard rings concatenated, so event
+    snapshots restore onto the same shard count only."""
+    from gossip_simulator_tpu.models import epidemic, event
+
+    ckpt_engine = "event" if "mail_ids" in tree else "ring"
+    if ckpt_engine != cfg.engine_resolved:
+        raise ValueError(
+            f"checkpoint was written by the {ckpt_engine} engine but "
+            f"this run resolves to {cfg.engine_resolved}; pass "
+            f"-engine {ckpt_engine} to restore it")
+    tree = dict(tree)
+    if ckpt_engine == "event" and "received" in tree:
+        # Pre-packed-flags event snapshot: fold the two bool arrays into
+        # the uint8 flags layout (bit0 received, bit1 crashed).
+        tree["flags"] = (
+            tree.pop("received").astype(np.uint8)
+            + tree.pop("crashed").astype(np.uint8) * 2)
+    n = int(tree["flags" if ckpt_engine == "event"
+                 else "received"].shape[0])
+    if n != cfg.n:
+        raise ValueError(
+            f"checkpoint has n={n} but this run has n={cfg.n}")
+    if ckpt_engine == "event":
+        n_local = n // n_shards
+        dw = event.ring_windows(cfg)
+        ncap = event.slot_cap(cfg, n_local)
+        nchunk = event.drain_chunk(cfg, n_local)
+        per_new = dw * ncap + nchunk
+        geom = tree.pop("mail_geom", None)
+        s_ckpt = (int(geom[2]) if geom is not None and len(geom) > 2 else 1)
+        if s_ckpt != n_shards:
+            raise ValueError(
+                f"checkpoint was written by the sharded backend over "
+                f"{s_ckpt} shard(s) but this run has {n_shards}; the "
+                "per-shard mail rings only restore onto the same device "
+                "count")
+        if tuple(tree["mail_cnt"].shape) != (n_shards, dw):
+            raise ValueError(
+                "checkpoint window-ring shape "
+                f"{tuple(tree['mail_cnt'].shape)} does not match this "
+                f"config's ({n_shards}, {dw}); restore with the snapshot's "
+                "-delaylow/-delayhigh")
+        mail_len = int(tree["mail_ids"].shape[0])
+        if geom is None:
+            # Legacy snapshot without geometry metadata: accept only an
+            # exact-layout match (repacking blind would mis-index slots).
+            if mail_len != n_shards * per_new:
+                raise ValueError(
+                    f"checkpoint mail-ring geometry ({mail_len},) does not "
+                    f"match this config's ({n_shards * per_new},) and the "
+                    "snapshot predates geometry metadata; restore with the "
+                    "same -delaylow/-delayhigh/-event-slot-cap/-event-chunk "
+                    "it was written with")
+        else:
+            ocap, ochunk = int(geom[0]), int(geom[1])
+            per_old = dw * ocap + ochunk
+            if mail_len != n_shards * per_old:
+                raise ValueError(
+                    f"checkpoint mail_ids length {mail_len} contradicts "
+                    f"its stored geometry (cap={ocap}, chunk={ochunk}, "
+                    f"{n_shards} shard(s))")
+            if (ocap, ochunk) != (ncap, nchunk):
+                old = np.asarray(tree["mail_ids"])
+                cnt = np.asarray(tree["mail_cnt"])
+                mails, cnts, lost = [], [], 0
+                for sh in range(n_shards):
+                    m, c, sl = repack_mail_ring(
+                        old[sh * per_old:(sh + 1) * per_old], cnt[sh],
+                        ocap, ochunk, ncap, nchunk, dw)
+                    mails.append(m)
+                    cnts.append(c)
+                    lost += sl
+                tree["mail_ids"] = np.concatenate(mails)
+                tree["mail_cnt"] = np.stack(cnts)
+                tree["mail_dropped"] = np.asarray(
+                    tree["mail_dropped"]) + np.int32(lost)
+    else:
+        d = epidemic.ring_depth(cfg)
+        if tuple(tree["pending"].shape) != (d, n):
+            raise ValueError(
+                f"checkpoint delay ring {tuple(tree['pending'].shape)} "
+                f"does not match this config's ({d}, {n}); restore with "
+                "the snapshot's -delaylow/-delayhigh/-time-mode")
+    tm = np.asarray(tree["total_message"])
+    if tm.ndim == 0:
+        # Pre-widening snapshot: scalar int32 counter -> [hi, lo] pair.
+        # & 0xFFFFFFFF also recovers a counter that had already wrapped
+        # negative (one int32 wrap reinterprets to the correct low word).
+        tree["total_message"] = np.asarray(
+            [0, int(tm) & 0xFFFFFFFF], dtype=np.uint32)
+    return tree
+
+
+def repack_mail_ring(mail: np.ndarray, cnt: np.ndarray, ocap: int,
+                     ochunk: int, ncap: int, nchunk: int,
+                     dw: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """Repack one packed mail ring (models/event.py layout: slot s occupies
+    [s*cap, (s+1)*cap), plus a drain-chunk tail) from slot geometry
+    (ocap, ochunk) to (ncap, nchunk) -- snapshots written under different
+    -event-* flags or an auto sizing that changed.  Entries beyond the new
+    capacity are dropped (returned in `lost`, counted like any overflow).
+
+    `cnt` is the per-slot entry count, shape (dw,).  Returns
+    (new_mail, clamped_cnt, lost)."""
+    if mail.shape[0] != dw * ocap + ochunk:
+        raise ValueError(
+            f"mail ring length {mail.shape[0]} contradicts its geometry "
+            f"(cap={ocap}, chunk={ochunk}, dw={dw})")
+    new = np.zeros((dw * ncap + nchunk,), mail.dtype)
+    lost = 0
+    for s in range(dw):
+        take = min(int(cnt[s]), ncap)
+        lost += int(cnt[s]) - take
+        new[s * ncap:s * ncap + take] = mail[s * ocap:s * ocap + take]
+    return new, np.minimum(cnt, ncap), lost
