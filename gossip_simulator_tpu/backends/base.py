@@ -66,8 +66,17 @@ class Stepper(abc.ABC):
         """Simulated milliseconds elapsed in the current phase."""
 
     # --- optional -------------------------------------------------------------
+    @property
+    def primary_host(self) -> bool:
+        """False on the non-zero ranks of a multi-process run: they
+        participate in collective snapshot gathers but must not write files
+        (every rank holds the same replicated/gathered values)."""
+        return True
+
     def state_pytree(self):
-        """Backend state as arrays for checkpointing; None if unsupported."""
+        """Backend state as arrays for checkpointing; None if unsupported.
+        Under -distributed this is a COLLECTIVE call: every process must
+        make it, even though only the primary host writes the result."""
         return None
 
     def load_state_pytree(self, tree) -> None:

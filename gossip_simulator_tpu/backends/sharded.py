@@ -21,8 +21,25 @@ from gossip_simulator_tpu.models.state import msg64_value
 from gossip_simulator_tpu.utils.metrics import Stats
 
 
+def _host_gather(x) -> np.ndarray:
+    """Leaf -> host array.  Under -distributed a node-sharded array is not
+    fully addressable from one process; process_allgather (a collective --
+    every process must traverse the same leaves in the same order, which
+    NamedTuple._asdict guarantees) assembles the global value on every
+    host.  Replicated scalars and single-process runs take the plain path."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 class ShardedStepper(Stepper):
     name = "sharded"
+
+    @property
+    def primary_host(self) -> bool:
+        return jax.process_index() == 0
 
     def __init__(self, cfg, n_devices: int | None = None):
         super().__init__(cfg)
@@ -174,7 +191,7 @@ class ShardedStepper(Stepper):
         state is layout-independent and restores onto any mesh)."""
         if self.state is None:
             return None
-        tree = {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        tree = {k: _host_gather(v) for k, v in self.state._asdict().items()}
         if "mail_ids" in tree:
             from gossip_simulator_tpu.models import event
 

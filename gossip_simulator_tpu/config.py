@@ -261,10 +261,6 @@ class Config:
         if self.distributed:
             if self.backend != "sharded":
                 raise ValueError("-distributed requires -backend sharded")
-            if self.checkpoint_every or self.resume:
-                raise ValueError(
-                    "-distributed does not support checkpoint/resume yet "
-                    "(snapshots would need globally-addressable gathers)")
             manual = (bool(self.coordinator), self.num_processes != -1,
                       self.process_id != -1)
             if any(manual) and not all(manual):

@@ -52,10 +52,16 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     if cfg.resume:
         from gossip_simulator_tpu.utils import checkpoint
 
+        # Under -distributed every rank reads the same snapshot (only rank 0
+        # writes them), so the checkpoint dir must be on a filesystem all
+        # hosts share -- the standard arrangement for multi-host training.
         path = checkpoint.latest(cfg.checkpoint_dir)
         if path is None:
             raise FileNotFoundError(
-                f"-resume: no snapshot found in {cfg.checkpoint_dir}")
+                f"-resume: no snapshot found in {cfg.checkpoint_dir}"
+                + (" (every process of a -distributed run must see the "
+                   "checkpoint dir; put it on a shared filesystem)"
+                   if cfg.distributed else ""))
         tree, meta = checkpoint.load(path)
         stepper.load_state_pytree(tree)
         resume_window = int(meta.get("window", 0))
@@ -162,8 +168,10 @@ class _Checkpointer:
             return
         from gossip_simulator_tpu.utils import checkpoint
 
+        # Collective on every rank (the sharded backend host-gathers);
+        # only the primary host writes the file.
         tree = self.stepper.state_pytree()
-        if tree is not None:
+        if tree is not None and self.stepper.primary_host:
             checkpoint.save(cfg.checkpoint_dir, window, tree, stats)
 
 

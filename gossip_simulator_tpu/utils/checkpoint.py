@@ -83,10 +83,11 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
         s_ckpt = (int(geom[2]) if geom is not None and len(geom) > 2 else 1)
         if s_ckpt != n_shards:
             raise ValueError(
-                f"checkpoint was written by the sharded backend over "
-                f"{s_ckpt} shard(s) but this run has {n_shards}; the "
-                "per-shard mail rings only restore onto the same device "
-                "count")
+                f"checkpoint mail rings were written over {s_ckpt} shard(s) "
+                f"but this run has {n_shards}; the per-shard layout only "
+                f"restores onto the same shard count (use -backend "
+                f"{'jax' if s_ckpt == 1 else 'sharded'} on "
+                f"{s_ckpt} device(s))")
         if tuple(tree["mail_cnt"].shape) != (n_shards, dw):
             raise ValueError(
                 "checkpoint window-ring shape "

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""TPU-side distributional validation of the Pallas graph generators.
+
+tests/test_pallas_graph.py can only check structure off-TPU (the interpret
+mode PRNG is an all-zero stub -- see ops/pallas_graph.py's own warning), so
+the statistical properties the simulation leans on -- destination
+uniformity, Poisson degrees, seed decorrelation -- are validated HERE on
+real hardware and recorded as an artifact (PALLAS_VALIDATION.json at the
+repo root).  bench.py runs this automatically during a TPU bench pass.
+
+Checks (all on freshly generated tables):
+* kout: chi-square destination uniformity over 256 buckets (statistic
+  within 5 sigma of its dof), mean/variance of the uniform draw, no self
+  loops, two seeds produce >99% differing entries.
+* erdos: degree mean/var against Poisson(lam), chi-square of the degree
+  histogram against the Poisson pmf (tail merged), destination uniformity,
+  no self loops in live slots.
+
+Run: python scripts/validate_pallas_tpu.py [--out PALLAS_VALIDATION.json]
+Exit 0 iff every check passes (also exits 3 when no TPU is present).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_simulator_tpu.utils import jaxsetup  # noqa: E402
+
+jaxsetup.setup()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _chi2_uniform(values: np.ndarray, n: int, buckets: int = 256) -> dict:
+    """Chi-square statistic of `values` (uniform over [0, n)) bucketed into
+    `buckets` equal ranges; 5-sigma window around the dof."""
+    counts = np.bincount((values.astype(np.int64) * buckets) // n,
+                         minlength=buckets)
+    expect = values.size / buckets
+    stat = float(((counts - expect) ** 2 / expect).sum())
+    dof = buckets - 1
+    bound = 5.0 * math.sqrt(2.0 * dof)
+    return {"stat": round(stat, 1), "dof": dof,
+            "window": [round(dof - bound, 1), round(dof + bound, 1)],
+            "ok": abs(stat - dof) <= bound}
+
+
+def _chi2_poisson(deg: np.ndarray, lam: float) -> dict:
+    """Chi-square of the observed degree histogram against Poisson(lam),
+    bins 0..hi with the tail merged so every expected count >= 5."""
+    m = deg.size
+    hi = int(lam + 5 * math.sqrt(lam))
+    pmf = np.zeros(hi + 2)
+    p = math.exp(-lam)
+    for i in range(hi + 1):
+        pmf[i] = p
+        p *= lam / (i + 1)
+    pmf[hi + 1] = max(1.0 - pmf[: hi + 1].sum(), 0.0)
+    counts = np.bincount(np.minimum(deg, hi + 1), minlength=hi + 2)
+    expect = pmf * m
+    keep = expect >= 5  # merge sparse tail bins into the window
+    stat = float(((counts[keep] - expect[keep]) ** 2 / expect[keep]).sum())
+    dof = int(keep.sum()) - 1
+    bound = 5.0 * math.sqrt(2.0 * dof)
+    return {"stat": round(stat, 1), "dof": dof,
+            "window": [round(dof - bound, 1), round(dof + bound, 1)],
+            "ok": abs(stat - dof) <= bound}
+
+
+def run_checks() -> dict:
+    from gossip_simulator_tpu.ops.pallas_graph import erdos_pallas, kout_pallas
+
+    checks = []
+
+    def add(name, ok, **detail):
+        checks.append({"name": name, "ok": bool(ok), **detail})
+
+    # --- kout -------------------------------------------------------------
+    n, k, rows = 1_000_000, 8, 131_072
+    f = np.asarray(kout_pallas(n, k, 0, rows, 7, False))
+    flat = f.reshape(-1)
+    add("kout_chi2_uniform", **_chi2_uniform(flat, n))
+    mean_rel = float(flat.mean() / ((n - 1) / 2) - 1)
+    add("kout_mean", abs(mean_rel) < 0.01, rel_err=round(mean_rel, 5))
+    var_rel = float(flat.var() / (n * n / 12.0) - 1)
+    add("kout_var", abs(var_rel) < 0.02, rel_err=round(var_rel, 5))
+    ids = np.arange(rows)[:, None]
+    add("kout_no_self_loops", (f != ids).all())
+    g = np.asarray(kout_pallas(n, k, 0, rows, 8, False))
+    differ = float((f != g).mean())
+    add("kout_seed_decorrelation", differ > 0.99, differ=round(differ, 5))
+
+    # --- erdos ------------------------------------------------------------
+    lam, rows_e = 8.0, 131_072
+    fe, deg = erdos_pallas(n, lam, 0, rows_e, 7, False)
+    fe, deg = np.asarray(fe), np.asarray(deg).astype(np.int64)
+    mean_err = float(deg.mean() - lam)
+    sigma = math.sqrt(lam / rows_e)
+    add("erdos_degree_mean", abs(mean_err) < 5 * sigma,
+        err=round(mean_err, 5), sigma5=round(5 * sigma, 5))
+    var_rel = float(deg.var() / lam - 1)
+    add("erdos_degree_var", abs(var_rel) < 0.05, rel_err=round(var_rel, 5))
+    add("erdos_degree_chi2_poisson", **_chi2_poisson(deg, lam))
+    live = np.arange(fe.shape[1])[None, :] < deg[:, None]
+    dests = fe[live]
+    add("erdos_chi2_uniform", **_chi2_uniform(dests, n))
+    ids_e = np.broadcast_to(np.arange(rows_e)[:, None], fe.shape)
+    add("erdos_no_self_loops", (fe[live] != ids_e[live]).all())
+
+    return {
+        "device": jax.devices()[0].device_kind,
+        "n": n, "kout_draws": rows * k, "erdos_rows": rows_e, "lam": lam,
+        "checks": checks,
+        "all_pass": all(c["ok"] for c in checks),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PALLAS_VALIDATION.json"))
+    args = ap.parse_args()
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "no TPU present; interpret-mode PRNG "
+                                     "validates nothing"}))
+        return 3
+    result = run_checks()
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+    return 0 if result["all_pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,7 +115,7 @@ def test_sharded_ring_resume_reproduces_trajectory(tmp_path):
     path = checkpoint.save(str(tmp_path), 1, s.state_pytree(), mid)
     reference = [s.gossip_window() for _ in range(5)]
 
-    s2 = _sharded(cfg)
+    s2 = _sharded(cfg.replace(resume=True, checkpoint_dir=str(tmp_path)))
     tree, _ = checkpoint.load(path)
     s2.load_state_pytree(tree)
     assert s2.stats() == mid
@@ -162,7 +162,7 @@ def test_sharded_resume_shard_count_mismatch_rejected(tmp_path):
                    engine="event", progress=False).validate()
     sj = JaxStepper(cfg_j)
     sj.init()
-    with pytest.raises(ValueError, match="sharded backend"):
+    with pytest.raises(ValueError, match="over 4 shard"):
         sj.load_state_pytree(tree)
 
 

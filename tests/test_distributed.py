@@ -30,20 +30,19 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn(rank: int, port: int):
+def _spawn(rank: int, port: int, args=None):
     from gossip_simulator_tpu.utils.jaxsetup import forced_cpu_env
 
     env = forced_cpu_env(4)  # appended flag wins over the parent's 8
-    cmd = [sys.executable, "-m", "gossip_simulator_tpu", *ARGS,
+    cmd = [sys.executable, "-m", "gossip_simulator_tpu",
+           *(ARGS if args is None else args),
            "-distributed", "-coordinator", f"localhost:{port}",
            "-num-processes", "2", "-process-id", str(rank)]
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
 
-def test_two_process_run_matches_single_process():
-    port = _free_port()
-    procs = [_spawn(r, port) for r in (0, 1)]
+def _join(procs):
     outs = []
     for p in procs:
         try:
@@ -53,6 +52,12 @@ def test_two_process_run_matches_single_process():
                 q.kill()
             pytest.fail("distributed run timed out")
         outs.append((p.returncode, out, err))
+    return outs
+
+
+def test_two_process_run_matches_single_process():
+    port = _free_port()
+    outs = _join([_spawn(r, port) for r in (0, 1)])
     for rc, out, err in outs:
         assert rc == 0, f"rank failed rc={rc}\nstdout:{out}\nstderr:{err}"
     # Only rank 0 prints simulator output (rank 1's stdout may carry
@@ -71,3 +76,36 @@ def test_two_process_run_matches_single_process():
     res = run_simulation(cfg, printer=ProgressPrinter(enabled=False))
     assert dist_msg == res.stats.total_message
     assert dist_crash == res.stats.total_crashed
+
+
+def test_two_process_checkpoint_resume(tmp_path):
+    """-distributed checkpoint/resume: rank 0 writes host-gathered snapshots
+    (the gather is collective across both OS processes), then a fresh
+    two-process run -resumes from them and converges to the same totals the
+    uninterrupted distributed run reports."""
+    ck = ["-checkpoint-dir", str(tmp_path)]
+    port = _free_port()
+    outs = _join([_spawn(r, port, args=[*ARGS, *ck, "-checkpoint-every", "1",
+                                        "-max-rounds", "30"])
+                  for r in (0, 1)])
+    for rc, out, err in outs:
+        assert rc == 2, f"expected non-convergence rc=2, got {rc}\n{err}"
+    from gossip_simulator_tpu.utils import checkpoint
+
+    assert checkpoint.latest(str(tmp_path)) is not None
+
+    port = _free_port()
+    outs = _join([_spawn(r, port, args=[*ARGS, *ck, "-resume"])
+                  for r in (0, 1)])
+    for rc, out, err in outs:
+        assert rc == 0, f"rank failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    m = re.search(r"Total message (\d+) Total Crashed (\d+)", outs[0][1])
+    assert m, outs[0][1]
+    # The resumed trajectory equals the uninterrupted one (same seed/ticks):
+    # totals match the plain two-process run of the same config.
+    cfg = Config(n=4000, graph="kout", fanout=6, seed=5, backend="sharded",
+                 engine="event", coverage_target=0.9, crashrate=0.01,
+                 progress=False).validate()
+    res = run_simulation(cfg, printer=ProgressPrinter(enabled=False))
+    assert int(m.group(1)) == res.stats.total_message
+    assert int(m.group(2)) == res.stats.total_crashed
