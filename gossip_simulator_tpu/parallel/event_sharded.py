@@ -87,12 +87,13 @@ def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
     messages and shard-local SIR triggers."""
     dw = event.ring_windows(cfg)
     cap = (mail.shape[0] - event.drain_chunk(cfg, n_local)) // dw
+    # One-hot column select instead of take_along_axis / cnt[0, wslot]
+    # gathers -- dw is tiny, the arithmetic fuses, and invalid rows'
+    # rank/base are don't-cares (see event.append_messages NOTE).
     oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
           & valid[:, None]).astype(I32)
-    rank = jnp.take_along_axis(
-        jnp.cumsum(oh, axis=0), jnp.where(valid, wslot, 0)[:, None],
-        axis=1)[:, 0] - 1
-    base = cnt[0, jnp.where(valid, wslot, 0)]
+    rank = (jnp.cumsum(oh, axis=0) * oh).sum(axis=1) - 1
+    base = (cnt[0][None, :] * oh).sum(axis=1)
     pos = base + rank
     ok = valid & (pos < cap)
     flat = jnp.where(ok, wslot * cap + pos, dw * cap)  # in-bounds trash cell

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Profile the production event-engine window at bench shape and rank device
+op costs (the data behind the README roadmap's percentages).
+
+Runs the epidemic to its steady state (a few windows past the seed), traces
+`--windows` windowed device calls with jax.profiler, then parses the chrome
+trace (plugins/profile/*/\\*.trace.json.gz) and aggregates device-track 'X'
+events by op name.
+
+Usage: python scripts/profile_window.py [--n 10000000] [--windows 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_simulator_tpu.utils import jaxsetup  # noqa: E402
+
+jaxsetup.setup()
+
+import jax  # noqa: E402
+
+from gossip_simulator_tpu.backends.jax_backend import JaxStepper  # noqa: E402
+from gossip_simulator_tpu.config import Config  # noqa: E402
+
+
+def parse_trace(trace_dir: str,
+                top: int = 18) -> tuple[list[tuple[str, float, int]], float]:
+    """Aggregate device-track complete ('X') events by name; return the
+    top ops as (name, total_ms, count) plus the loop total (the longest
+    single op -- the outer while -- whose duration IS the device time of
+    the traced region; summing all ops would double-count nested
+    jit/while wrappers)."""
+    paths = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
+                                   "*.trace.json.gz"))
+    if not paths:
+        raise FileNotFoundError(f"no trace under {trace_dir}")
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # Device tracks: pid whose process_name mentions TPU/device (the host
+    # python tracks carry the same op names prefixed differently).
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    device_pids = {p for p, nm in pid_names.items()
+                   if "TPU" in nm or "/device:" in nm or "Chip" in nm}
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "?")
+        agg[name] += e.get("dur", 0) / 1e3  # us -> ms
+        cnt[name] += 1
+    loop_total = max(agg.values(), default=0.0)
+    return [(nm, ms, cnt[nm]) for nm, ms in agg.most_common(top)], loop_total
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000_000)
+    ap.add_argument("--windows", type=int, default=20)
+    ap.add_argument("--out", default="/tmp/gossip_profile")
+    args = ap.parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = Config(n=args.n, fanout=3, graph="kout", backend="jax", seed=0,
+                 crashrate=0.001, coverage_target=0.90, max_rounds=3000,
+                 pallas=on_tpu, progress=False).validate()
+    s = JaxStepper(cfg)
+    s.init()
+    s.seed()
+    # Steady state: run past the early near-empty windows.
+    for _ in range(8):
+        s.gossip_window()
+    jax.block_until_ready(s.state.flags)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(args.out):
+        for _ in range(args.windows):
+            s.gossip_window()
+        jax.block_until_ready(s.state.flags)
+    wall = time.perf_counter() - t0
+    rows, loop_total = parse_trace(args.out)
+    print(f"device={jax.devices()[0].device_kind} n={cfg.n} "
+          f"windows={args.windows} wall={wall:.2f}s "
+          f"({wall / args.windows * 1e3:.1f} ms/window, device "
+          f"{loop_total / args.windows:.1f} ms/window)")
+    print(f"{'op':44s} {'ms_total':>9s} {'ms/win':>8s} {'count':>6s} "
+          f"{'%loop':>5s}")
+    for nm, ms, c in rows:
+        print(f"{nm[:44]:44s} {ms:9.1f} {ms / args.windows:8.2f} {c:6d} "
+              f"{100 * ms / loop_total:5.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

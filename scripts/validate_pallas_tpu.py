@@ -53,8 +53,10 @@ def _chi2_uniform(values: np.ndarray, n: int, buckets: int = 256) -> dict:
 
 
 def _chi2_poisson(deg: np.ndarray, lam: float) -> dict:
-    """Chi-square of the observed degree histogram against Poisson(lam),
-    bins 0..hi with the tail merged so every expected count >= 5."""
+    """Chi-square of the observed degree histogram against Poisson(lam).
+    Sparse edge bins are MERGED into their neighbors (not dropped) until
+    every bin's expected count is >= 5, so excess mass in the clamped
+    overflow tail still moves the statistic."""
     m = deg.size
     hi = int(lam + 5 * math.sqrt(lam))
     pmf = np.zeros(hi + 2)
@@ -63,11 +65,20 @@ def _chi2_poisson(deg: np.ndarray, lam: float) -> dict:
         pmf[i] = p
         p *= lam / (i + 1)
     pmf[hi + 1] = max(1.0 - pmf[: hi + 1].sum(), 0.0)
-    counts = np.bincount(np.minimum(deg, hi + 1), minlength=hi + 2)
-    expect = pmf * m
-    keep = expect >= 5  # merge sparse tail bins into the window
-    stat = float(((counts[keep] - expect[keep]) ** 2 / expect[keep]).sum())
-    dof = int(keep.sum()) - 1
+    obs = list(np.bincount(np.minimum(deg, hi + 1), minlength=hi + 2)
+               .astype(float))
+    exp = list(pmf * m)
+    while len(exp) > 1 and exp[-1] < 5:  # fold the tail inward
+        exp[-2] += exp.pop()
+        obs[-2] += obs.pop()
+    while len(exp) > 1 and exp[0] < 5:  # and the low-degree head
+        exp[1] += exp[0]
+        obs[1] += obs[0]
+        exp.pop(0)
+        obs.pop(0)
+    o, e = np.asarray(obs), np.asarray(exp)
+    stat = float(((o - e) ** 2 / e).sum())
+    dof = len(exp) - 1
     bound = 5.0 * math.sqrt(2.0 * dof)
     return {"stat": round(stat, 1), "dof": dof,
             "window": [round(dof - bound, 1), round(dof + bound, 1)],
