@@ -40,8 +40,17 @@ class JaxStepper(Stepper):
             self.state = None
             self._overlay_done = True
         elif cfg.graph == "overlay":
-            self._oround = jax.jit(overlay.make_round_fn(cfg))
-            self.ostate = overlay.init_state(cfg)
+            self._faithful_overlay = cfg.overlay_mode == "ticks"
+            if self._faithful_overlay:
+                from gossip_simulator_tpu.models import overlay_ticks
+
+                self._omod = overlay_ticks
+                self._oround = overlay_ticks.make_poll_fn(cfg)
+                self.ostate = overlay_ticks.init_state(cfg, self.key)
+            else:
+                self._omod = overlay
+                self._oround = jax.jit(overlay.make_round_fn(cfg))
+                self.ostate = overlay.init_state(cfg)
             self._overlay_done = False
             self.state = None
         else:
@@ -60,15 +69,21 @@ class JaxStepper(Stepper):
             return 0, 0, True
         self.ostate = self._oround(self.ostate, self.key)
         self._overlay_rounds += 1
-        mk, bk, q = jax.device_get(
+        faithful = self._faithful_overlay
+        tick = self.ostate.tick if faithful else 0
+        mk, bk, q, tick = jax.device_get(
             (self.ostate.win_makeups, self.ostate.win_breakups,
-             overlay.quiesced(self.ostate)))
+             self._omod.quiesced(self.ostate), tick))
+        # True simulated ms from the tick clock in faithful mode; the
+        # rounds engine only estimates rounds x mean_delay.
+        self._phase1_ms = (float(tick) if faithful
+                           else self._overlay_rounds * self._mean_delay)
         if bool(q):
             self._overlay_done = True
             # Freeze phase-1 elapsed time: once the epidemic state exists,
             # sim_time_ms switches to its tick (which starts at 0), so the
             # driver's "Took Xms to stabilize" needs this snapshot.
-            self._stabilize_ms = self._overlay_rounds * self._mean_delay
+            self._stabilize_ms = self._phase1_ms
             self._mailbox_dropped = int(jax.device_get(
                 self.ostate.mailbox_dropped))
             self.state = self._engine.init_state(
@@ -127,7 +142,8 @@ class JaxStepper(Stepper):
 
     def sim_time_ms(self) -> float:
         if self.state is None or not self._overlay_done:
-            return self._overlay_rounds * self._mean_delay
+            return getattr(self, "_phase1_ms",
+                           self._overlay_rounds * self._mean_delay)
         if not getattr(self, "_seeded", False):
             # Between quiescence and the broadcast: phase-1 elapsed time
             # (the epidemic tick is 0 and would misreport stabilization).

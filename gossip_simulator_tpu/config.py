@@ -104,6 +104,15 @@ class Config:
     # Event engine drain chunk size (-1 = auto: 524288; see
     # event.drain_chunk).
     event_chunk: int = -1
+    # Phase-1 overlay timing (graph=overlay): "rounds" batches membership
+    # into synchronous rounds, delivering every emission exactly one round
+    # later and ESTIMATING stabilization time as rounds x mean_delay;
+    # "ticks" keeps the reference's per-message uniform delays through a
+    # packed window-slot ring (models/overlay_ticks.py) so the
+    # stabilization clock is true simulated ms (simulator.go:151-168).
+    # "ticks" is jax-backend-only for now; native/cpp are inherently
+    # faithful (discrete-event).
+    overlay_mode: str = "rounds"
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
@@ -258,6 +267,20 @@ class Config:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"
             )
+        if self.overlay_mode not in ("rounds", "ticks"):
+            raise ValueError(
+                f"overlay_mode must be 'rounds' or 'ticks', "
+                f"got {self.overlay_mode!r}")
+        if self.overlay_mode == "ticks" and self.graph == "overlay":
+            # native/cpp are discrete-event and inherently faithful, so the
+            # flag is a no-op there; only the vectorized backends gate.
+            if self.backend == "sharded":
+                raise ValueError(
+                    "-overlay-mode ticks is jax-backend-only for now "
+                    "(the sharded overlay runs in rounds mode)")
+            if self.backend == "jax" and self.effective_time_mode != "ticks":
+                raise ValueError(
+                    "-overlay-mode ticks requires -time-mode ticks")
         if self.distributed:
             if self.backend != "sharded":
                 raise ValueError("-distributed requires -backend sharded")
@@ -359,6 +382,8 @@ def _build_parser() -> argparse.ArgumentParser:
                    dest="event_slot_cap", type=int, default=d.event_slot_cap)
     p.add_argument("-event-chunk", "--event-chunk", dest="event_chunk",
                    type=int, default=d.event_chunk)
+    p.add_argument("-overlay-mode", "--overlay-mode", dest="overlay_mode",
+                   choices=("rounds", "ticks"), default=d.overlay_mode)
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

@@ -1,0 +1,360 @@
+"""Tick-faithful dynamic-overlay construction (phase 1, `-overlay-mode ticks`).
+
+The round-based engine (models/overlay.py) quantizes time: every emission is
+delivered exactly one round later, and stabilization time is estimated as
+rounds x mean_delay.  This engine keeps the reference's timing model instead:
+every makeup/breakup send draws its OWN uniform delay in
+[delaylow, delayhigh) ms (simulator.go:151-164, RandomNetworkDelay at
+166-168), messages sit in a packed window-slot ring (the same layout as the
+phase-2 event engine, models/event.py), and the stabilization clock is true
+simulated milliseconds -- upgrading phase 1 to the same "option (b) faithful
+ticks" story phase 2 already has (SURVEY §5.8).
+
+Sequencing per B-tick window (B = min(10, delaylow), so a message emitted in
+one window always arrives in a later one):
+  1. drain this window's ring slot; stable-sort entries by arrival tick so
+     per-node mailbox order is arrival order;
+  2. deliver breakups / makeups into fixed-capacity mailboxes
+     (ops/mailbox.deliver) and process them slot-sequentially,
+     node-parallel with the SAME per-message decision rules as the round
+     engine (accept-under-fanin / evict-random / replace-on-breakup,
+     simulator.go:66-94);
+  3. every emission (replacement makeup, eviction breakup) is appended to
+     the ring at its trigger's arrival tick plus a fresh per-message delay.
+
+Bootstrap is a window-0 burst: the reference's needNewFriend loop re-arms
+with no delay (simulator.go:103-105), so a node fills all `fanout` slots
+at t~0, each makeup carrying an independent delay -- and once a node
+reaches fanout it can never drop below it (breakup under/at fanout
+replaces in place; removal only happens above fanout), so the loop never
+re-fires.  init_state therefore draws the whole initial friends table and
+appends the n*fanout makeup burst directly.
+
+Quiescence is race-free and in the reference's own terms: a full 10 ms poll
+window with zero processed membership messages AND an empty ring
+(simulator.go:221-234 without the read-reset race, SURVEY §5.2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.ops.mailbox import deliver
+from gossip_simulator_tpu.ops.select import first_true_indices
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+
+MK = 0  # payload type bits: makeup
+BK = 1  # breakup
+
+
+def batch_ticks(cfg: Config) -> int:
+    """Window size B: delays >= delaylow >= B guarantee no intra-window
+    causality; also bounded so pay = (src*2+type)*b + toff fits int32."""
+    b = max(1, min(10, cfg.delaylow))
+    while b > 1 and (2 * cfg.n + 2) * b >= 2**31:
+        b //= 2
+    return b
+
+
+def ring_windows(cfg: Config) -> int:
+    b = batch_ticks(cfg)
+    return (b - 1 + cfg.delayhigh - 1) // b + 1
+
+
+def slot_cap(cfg: Config) -> int:
+    """Packed entries per window slot.  Peak traffic is the bootstrap burst
+    (n*fanout makeups) spread over the delay span, plus a comparable
+    response wave; 2x covers skew.  Overflow is counted, never silent."""
+    b = batch_ticks(cfg)
+    dw = ring_windows(cfg)
+    cap = max(4096, int(math.ceil(
+        2.0 * cfg.n * cfg.fanout * b / max(cfg.delay_span, 1))))
+    cap = min(cap, (3 * 2**30) // (8 * max(dw, 1)))  # ~3 GB for both arrays
+    return min(cap, (2**31 - 2) // max(dw, 1))
+
+
+def emit_chunk(cfg: Config) -> int:
+    """Emission-compaction chunk (the drain_chunk analog)."""
+    return min(slot_cap(cfg), max(4096, min(262_144, cfg.n // 8)))
+
+
+class OverlayTickState(NamedTuple):
+    friends: jnp.ndarray  # int32[n, k]  -1 padded
+    friend_cnt: jnp.ndarray  # int32[n]
+    # Packed ring, slot s at [s*cap, (s+1)*cap); last element = trash cell.
+    ring_dst: jnp.ndarray  # int32[dw*cap + 1]
+    ring_pay: jnp.ndarray  # int32[dw*cap + 1]  (src*2 + type)*b + toff
+    ring_cnt: jnp.ndarray  # int32[1, dw]
+    tick: jnp.ndarray  # int32[]  window-aligned simulated ms
+    makeups: jnp.ndarray  # int32[]  cumulative processed (MakeUps)
+    breakups: jnp.ndarray  # int32[]
+    win_makeups: jnp.ndarray  # int32[]  this POLL window's counts
+    win_breakups: jnp.ndarray  # int32[]
+    mailbox_dropped: jnp.ndarray  # int32[]  mailbox + ring overflow
+
+
+def _append(cfg: Config, ring_dst, ring_pay, ring_cnt, dropped,
+            dst, pay, wslot, valid):
+    """Append one (dst, pay) entry per True in `valid` into its window
+    slot (shared one-hot reservation: ops.mailbox.ring_append)."""
+    from gossip_simulator_tpu.ops.mailbox import ring_append
+
+    dw = ring_windows(cfg)
+    cap = (ring_dst.shape[0] - 1) // dw
+    (ring_dst, ring_pay), ring_cnt, dropped = ring_append(
+        (ring_dst, ring_pay), ring_cnt, dropped, (dst, pay), wslot, valid,
+        dw, cap)
+    return ring_dst, ring_pay, ring_cnt, dropped
+
+
+def init_state(cfg: Config, base_key: jax.Array) -> OverlayTickState:
+    """Initial friends table + the window-0 bootstrap makeup burst."""
+    n, k, f = cfg.n, cfg.max_degree, cfg.fanout
+    b = batch_ticks(cfg)
+    dw = ring_windows(cfg)
+    cap = slot_cap(cfg)
+    ids = jnp.arange(n, dtype=I32)
+    kb = _rng.tick_key(base_key, 0, _rng.OP_BOOTSTRAP)
+    # One independent draw per (node, slot), self patched (id+1)%n
+    # (simulator.go:97-100); duplicates allowed, like the reference.
+    w = jax.vmap(lambda kk: jax.random.randint(kk, (f,), 0, n, dtype=I32))(
+        _rng.row_keys(kb, ids))
+    w = jnp.where(w == ids[:, None], (w + 1) % n, w)
+    friends = jnp.full((n, k), -1, I32).at[:, :f].set(w)
+    cnt = jnp.full((n,), f, I32)
+
+    ring_dst = jnp.zeros((dw * cap + 1,), I32)
+    ring_pay = jnp.zeros((dw * cap + 1,), I32)
+    ring_cnt = jnp.zeros((1, dw), I32)
+    z = lambda: jnp.zeros((), I32)
+    st = OverlayTickState(
+        friends=friends, friend_cnt=cnt,
+        ring_dst=ring_dst, ring_pay=ring_pay, ring_cnt=ring_cnt,
+        tick=z(), makeups=z(), breakups=z(),
+        win_makeups=z(), win_breakups=z(), mailbox_dropped=z())
+    # The burst: n*f makeups at t=0, each with its own delay.  Appended in
+    # chunks through the same path as every later emission.
+    kd = _rng.tick_key(base_key, 0, _rng.OP_DELAY)
+    flat_n = n * f
+    chunk = emit_chunk(cfg)
+
+    def append_chunk(i, carry):
+        ring_dst, ring_pay, ring_cnt, dropped = carry
+        idx = i * chunk + jnp.arange(chunk, dtype=I32)
+        valid = idx < flat_n
+        src = jnp.where(valid, idx // f, 0)
+        dst = w.reshape(-1).at[jnp.where(valid, idx, 0)].get()
+        delay = _rng.row_uniform_delay(kd, cfg.delaylow, cfg.delayhigh, idx)
+        arrive = delay  # emitted at t=0
+        return _append(cfg, ring_dst, ring_pay, ring_cnt, dropped,
+                       dst, (src * 2 + MK) * b + arrive % b,
+                       (arrive // b) % dw, valid)
+
+    ring_dst, ring_pay, ring_cnt, dropped = jax.lax.fori_loop(
+        0, -(-flat_n // chunk), append_chunk,
+        (ring_dst, ring_pay, ring_cnt, st.mailbox_dropped))
+    return st._replace(ring_dst=ring_dst, ring_pay=ring_pay,
+                       ring_cnt=ring_cnt, mailbox_dropped=dropped)
+
+
+def _emit_all(cfg: Config, st_ring, base_key, w, em_dst, em_toff, typ, op):
+    """Compact an (n, cap_mb) emission buffer and append every entry with a
+    fresh per-message delay drawn at its trigger's arrival tick."""
+    ring_dst, ring_pay, ring_cnt, dropped = st_ring
+    b = batch_ticks(cfg)
+    dw = ring_windows(cfg)
+    cols = em_dst.shape[1]
+    flat_n = em_dst.shape[0] * cols
+    dflat = em_dst.reshape(-1)
+    tflat = em_toff.reshape(-1)
+    valid_all = dflat >= 0
+    total = valid_all.sum(dtype=I32)
+    chunk = min(emit_chunk(cfg), flat_n)
+    kd = _rng.tick_key(base_key, w, op)
+
+    def body(_, carry):
+        ring_dst, ring_pay, ring_cnt, dropped, remaining = carry
+        idx = first_true_indices(remaining, chunk)
+        hit = jnp.zeros((flat_n,), bool).at[idx].set(True, mode="drop")
+        remaining = remaining & ~hit
+        ok = idx < flat_n
+        src = jnp.where(ok, idx // cols, 0)
+        dst = dflat.at[idx].get(mode="fill", fill_value=-1)
+        toff = tflat.at[idx].get(mode="fill", fill_value=0)
+        valid = dst >= 0
+        # Row-keyed by flat emission index: deterministic and independent
+        # regardless of chunking.
+        delay = _rng.row_uniform_delay(kd, cfg.delaylow, cfg.delayhigh, idx)
+        arrive = w * b + toff + delay
+        ring_dst, ring_pay, ring_cnt, dropped = _append(
+            cfg, ring_dst, ring_pay, ring_cnt, dropped,
+            jnp.where(valid, dst, 0),
+            (src * 2 + typ) * b + arrive % b,
+            (arrive // b) % dw, valid)
+        return ring_dst, ring_pay, ring_cnt, dropped, remaining
+
+    out = jax.lax.fori_loop(0, (total + chunk - 1) // chunk, body,
+                            (ring_dst, ring_pay, ring_cnt, dropped,
+                             valid_all))
+    return out[:4]
+
+
+def make_step_fn(cfg: Config):
+    """One B-tick window transition (drain -> deliver -> process -> emit)."""
+    n, k = cfg.n, cfg.max_degree
+    fanout, fanin = cfg.fanout, cfg.fanin_resolved
+    b = batch_ticks(cfg)
+    dw = ring_windows(cfg)
+    cap = slot_cap(cfg)
+    cap_mb = cfg.mailbox_cap_resolved
+
+    def step_fn(st: OverlayTickState, base_key: jax.Array) -> OverlayTickState:
+        w = st.tick // b
+        slot = w % dw
+        m = st.ring_cnt[0, slot]
+        dst_e = jax.lax.dynamic_slice(st.ring_dst, (slot * cap,), (cap,))
+        pay_e = jax.lax.dynamic_slice(st.ring_pay, (slot * cap,), (cap,))
+        evalid = jnp.arange(cap, dtype=I32) < m
+        # Arrival order within the window: stable sort by tick offset.
+        toff_key = jnp.where(evalid, pay_e % b, b)
+        toff_key, dst_e, pay_e = jax.lax.sort(
+            (toff_key, dst_e, pay_e), num_keys=1, is_stable=True)
+        evalid = toff_key < b
+        typ = (pay_e // b) % 2
+        mbox_pay = (pay_e // (2 * b)) * b + pay_e % b  # src*b + toff
+        mk_mbox, drop1, _ = _deliver(mbox_pay, dst_e, evalid & (typ == MK))
+        bk_mbox, drop2, _ = _deliver(mbox_pay, dst_e, evalid & (typ == BK))
+        dropped = st.mailbox_dropped + drop1 + drop2
+        ring_cnt = st.ring_cnt.at[0, slot].set(0)
+
+        rkey = _rng.tick_key(base_key, w, _rng.OP_REPLACE)
+        ekey = _rng.tick_key(base_key, w, _rng.OP_EVICT)
+        ids = jnp.arange(n, dtype=I32)
+        rows = ids
+        friends, cnt = st.friends, st.friend_cnt
+        mk_em_dst = jnp.full((n, cap_mb), -1, I32)
+        mk_em_toff = jnp.zeros((n, cap_mb), I32)
+        bk_em_dst = jnp.full((n, cap_mb), -1, I32)
+        bk_em_toff = jnp.zeros((n, cap_mb), I32)
+        win_mk = jnp.zeros((), I32)
+        win_bk = jnp.zeros((), I32)
+
+        # --- breakups (simulator.go:76-94), slot-sequential ---------------
+        def bk_body(sl, carry):
+            friends, cnt, mk_em_dst, mk_em_toff, win_bk = carry
+            pay = bk_mbox[:, sl]
+            has = pay >= 0
+            src = jnp.where(has, pay // b, 0)
+            toff = jnp.where(has, pay % b, 0)
+            in_range = jnp.arange(k, dtype=I32)[None, :] < cnt[:, None]
+            match = (friends == src[:, None]) & in_range & has[:, None]
+            found = match.any(axis=1)
+            pos = jnp.argmax(match, axis=1).astype(I32)  # first match
+            over = cnt > fanout
+            rm = has & found & over
+            rp = has & found & ~over
+            kk = jax.random.fold_in(rkey, sl)
+            nf = _rng.randint_excluding(kk, n, (n,), src, ids)
+            lastpos = jnp.maximum(cnt - 1, 0)
+            lastval = friends[rows, lastpos]
+            posval = jnp.where(rm, lastval,
+                               jnp.where(rp, nf, friends[rows, pos]))
+            friends = friends.at[rows, pos].set(posval)
+            friends = friends.at[rows, lastpos].set(
+                jnp.where(rm, -1, friends[rows, lastpos]))
+            cnt = cnt - rm.astype(I32)
+            mk_em_dst = mk_em_dst.at[:, sl].set(jnp.where(rp, nf, -1))
+            mk_em_toff = mk_em_toff.at[:, sl].set(toff)
+            return (friends, cnt, mk_em_dst, mk_em_toff,
+                    win_bk + has.sum(dtype=I32))
+
+        n_bk = (bk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
+        friends, cnt, mk_em_dst, mk_em_toff, win_bk = jax.lax.fori_loop(
+            0, n_bk, bk_body,
+            (friends, cnt, mk_em_dst, mk_em_toff, win_bk))
+
+        # --- makeups (simulator.go:66-75) ----------------------------------
+        def mk_body(sl, carry):
+            friends, cnt, bk_em_dst, bk_em_toff, win_mk = carry
+            pay = mk_mbox[:, sl]
+            has = pay >= 0
+            src = jnp.where(has, pay // b, 0)
+            toff = jnp.where(has, pay % b, 0)
+            under = cnt < fanin
+            app = has & under
+            appcol = jnp.minimum(cnt, k - 1)
+            cur = friends[rows, appcol]
+            friends = friends.at[rows, appcol].set(
+                jnp.where(app, src, cur))
+            cnt = cnt + app.astype(I32)
+            ev = has & ~under
+            kk = jax.random.fold_in(ekey, sl)
+            vpos = jax.random.randint(kk, (n,), 0, jnp.maximum(cnt, 1),
+                                      dtype=I32)
+            victim = friends[rows, vpos]
+            friends = friends.at[rows, vpos].set(
+                jnp.where(ev, src, victim))
+            bk_em_dst = bk_em_dst.at[:, sl].set(jnp.where(ev, victim, -1))
+            bk_em_toff = bk_em_toff.at[:, sl].set(toff)
+            return (friends, cnt, bk_em_dst, bk_em_toff,
+                    win_mk + has.sum(dtype=I32))
+
+        n_mk = (mk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
+        friends, cnt, bk_em_dst, bk_em_toff, win_mk = jax.lax.fori_loop(
+            0, n_mk, mk_body,
+            (friends, cnt, bk_em_dst, bk_em_toff, win_mk))
+
+        # --- emissions -> ring, per-message delays -------------------------
+        ring = (st.ring_dst, st.ring_pay, ring_cnt, dropped)
+        ring = _emit_all(cfg, ring, base_key, w, mk_em_dst, mk_em_toff,
+                         MK, _rng.OP_DELAY)
+        ring = _emit_all(cfg, ring, base_key, w, bk_em_dst, bk_em_toff,
+                         BK, _rng.OP_DELAY_BK)
+        ring_dst, ring_pay, ring_cnt, dropped = ring
+
+        return OverlayTickState(
+            friends=friends, friend_cnt=cnt,
+            ring_dst=ring_dst, ring_pay=ring_pay, ring_cnt=ring_cnt,
+            tick=st.tick + b,
+            makeups=st.makeups + win_mk, breakups=st.breakups + win_bk,
+            win_makeups=st.win_makeups + win_mk,
+            win_breakups=st.win_breakups + win_bk,
+            mailbox_dropped=dropped)
+
+    def _deliver(src_pay, dst, valid):
+        mbox, count, drp = deliver(src_pay, dst, valid, n, cap_mb,
+                                   compact_chunk=max(4096, n))
+        return mbox, drp, count
+
+    return step_fn
+
+
+def make_poll_fn(cfg: Config):
+    """One 10 ms poll window (ceil(10/B) steps) as one jitted device call;
+    win_makeups/win_breakups accumulate over the poll window, matching the
+    reference's polled-atomics observation cadence (simulator.go:221-234)."""
+    import functools
+
+    step = make_step_fn(cfg)
+    steps = max(1, -(-10 // batch_ticks(cfg)))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def poll_fn(st: OverlayTickState, base_key) -> OverlayTickState:
+        st = st._replace(win_makeups=jnp.zeros((), I32),
+                         win_breakups=jnp.zeros((), I32))
+        return jax.lax.fori_loop(0, steps, lambda _, s: step(s, base_key), st)
+
+    return poll_fn
+
+
+def quiesced(st: OverlayTickState) -> jnp.ndarray:
+    """A full poll window with zero processed messages AND an empty ring."""
+    return ((st.win_makeups == 0) & (st.win_breakups == 0)
+            & ~jnp.any(st.ring_cnt > 0) & (st.tick > 0))

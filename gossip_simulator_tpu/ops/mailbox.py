@@ -27,6 +27,34 @@ from gossip_simulator_tpu.ops.select import first_true_indices
 _warned_dense_fallback = False
 
 
+def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
+                cap: int):
+    """Append one entry per True in `valid` into its `wslot` window slot of
+    the packed ring(s): one-hot reservation ranks (emission order, no
+    gathers -- dw is tiny), bounds-checked against the slot capacity, with
+    overflow counted in `dropped` and overflowed writes diverted to the
+    dw*cap trash cell (this platform miscompiled flat OOB-drop scatters;
+    see epidemic.deposit_local).
+
+    `rings`/`payloads` are equal-length tuples -- every ring gets the same
+    flat positions, so multi-array entries (e.g. the overlay's (dst, pay)
+    pair) stay aligned.  Shared by parallel/event_sharded._ring_append and
+    models/overlay_ticks; models/event.append_messages keeps its own
+    multi-entry-per-row reservation variant."""
+    oh = ((wslot[:, None] == jnp.arange(dw, dtype=jnp.int32)[None, :])
+          & valid[:, None]).astype(jnp.int32)
+    rank = (jnp.cumsum(oh, axis=0) * oh).sum(axis=1) - 1
+    base = (cnt[0][None, :] * oh).sum(axis=1)
+    pos = base + rank
+    ok = valid & (pos < cap)
+    flat = jnp.where(ok, wslot * cap + pos, dw * cap)  # in-bounds trash cell
+    rings = tuple(r.at[flat].set(jnp.where(ok, p, 0))
+                  for r, p in zip(rings, payloads))
+    cnt = cnt + (oh * ok[:, None]).sum(axis=0)[None, :]
+    dropped = dropped + (valid & ~ok).sum(dtype=jnp.int32)
+    return rings, cnt, dropped
+
+
 def segment_ranks(sorted_keys: jnp.ndarray) -> jnp.ndarray:
     """Rank of each element within its run of equal values (input sorted).
 

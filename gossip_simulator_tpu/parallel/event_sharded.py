@@ -85,21 +85,12 @@ def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
     overflow counted in `dropped`, out-of-capacity writes diverted to the
     dw*cap trash cell.  The single reservation path for both routed data
     messages and shard-local SIR triggers."""
+    from gossip_simulator_tpu.ops.mailbox import ring_append
+
     dw = event.ring_windows(cfg)
     cap = (mail.shape[0] - event.drain_chunk(cfg, n_local)) // dw
-    # One-hot column select instead of take_along_axis / cnt[0, wslot]
-    # gathers -- dw is tiny, the arithmetic fuses, and invalid rows'
-    # rank/base are don't-cares (see event.append_messages NOTE).
-    oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
-          & valid[:, None]).astype(I32)
-    rank = (jnp.cumsum(oh, axis=0) * oh).sum(axis=1) - 1
-    base = (cnt[0][None, :] * oh).sum(axis=1)
-    pos = base + rank
-    ok = valid & (pos < cap)
-    flat = jnp.where(ok, wslot * cap + pos, dw * cap)  # in-bounds trash cell
-    mail = mail.at[flat].set(jnp.where(ok, payload, 0))
-    cnt = cnt + (oh * ok[:, None]).sum(axis=0)[None, :]
-    dropped = dropped + (valid & ~ok).sum(dtype=I32)
+    (mail,), cnt, dropped = ring_append(
+        (mail,), cnt, dropped, (payload,), wslot, valid, dw, cap)
     return mail, cnt, dropped
 
 

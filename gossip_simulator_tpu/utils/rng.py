@@ -25,6 +25,7 @@ OP_SEED_NODE = 7
 OP_GRAPH = 8
 OP_PULL = 9
 OP_REMOVE = 10
+OP_DELAY_BK = 11  # overlay-ticks breakup-send delays (makeups use OP_DELAY)
 
 
 def base_key(seed: int) -> jax.Array:
