@@ -62,10 +62,84 @@ def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     )
 
 
+def _col_onehot(cols, k: int):
+    """bool[n, k]: row r's `cols[r]` column.  The friends width k is tiny
+    (~6), so per-row column reads/writes are ONE-HOT ELEMENTWISE ops, not
+    2-D index gathers/scatters -- which cost a full per-op floor each on
+    this platform (~15x slower; see epidemic.deposit_local NOTE).  This
+    one change took the 1M overlay round from ~500 to O(100) ms."""
+    return jnp.arange(k, dtype=I32)[None, :] == cols[:, None]
+
+
+def _col_get(arr, cols):
+    """arr[rows, cols] via one-hot select (see _col_onehot)."""
+    return jnp.where(_col_onehot(cols, arr.shape[1]), arr, 0).sum(
+        axis=1, dtype=arr.dtype)
+
+
+def _col_set(arr, cols, vals, mask=None):
+    """arr[rows, cols] = vals (where mask) via one-hot blend."""
+    oh = _col_onehot(cols, arr.shape[1])
+    if mask is not None:
+        oh = oh & mask[:, None]
+    return jnp.where(oh, vals[:, None], arr)
+
+
 def _masked_set(arr, rows, cols, vals, mask):
-    """arr[rows, cols] = vals where mask (scatter with blend)."""
-    cur = arr[rows, cols]
-    return arr.at[rows, cols].set(jnp.where(mask, vals, cur))
+    """arr[rows, cols] = vals where mask (one-hot blend; `rows` must be
+    the dense arange -- true for every caller)."""
+    del rows
+    return _col_set(arr, cols, vals, mask)
+
+
+def process_breakup_slot(n, fanout, friends, cnt, src, has, ids, kk):
+    """One mailbox slot of breakup decisions for ALL nodes in parallel
+    (simulator.go:76-94): first-match scan; over fanout -> remove
+    (swap-with-last); else replace in place with a fresh random peer
+    (!= self, != leaver) to whom a makeup must be sent.
+
+    Shared by the round engine and the tick-faithful engine
+    (models/overlay_ticks.py) so the decision rules can never diverge.
+    Returns (friends, cnt, reply_dst, reply_mask): send makeup to
+    reply_dst where reply_mask."""
+    k = friends.shape[1]
+    in_range = jnp.arange(k, dtype=I32)[None, :] < cnt[:, None]
+    match = (friends == src[:, None]) & in_range & has[:, None]
+    found = match.any(axis=1)
+    pos = jnp.argmax(match, axis=1).astype(I32)  # first match
+    over = cnt > fanout
+    rm = has & found & over
+    rp = has & found & ~over
+    nf = _rng.randint_excluding(kk, n, (cnt.shape[0],), src, ids)
+    lastpos = jnp.maximum(cnt - 1, 0)
+    lastval = _col_get(friends, lastpos)
+    posval = jnp.where(rm, lastval,
+                       jnp.where(rp, nf, _col_get(friends, pos)))
+    friends = _col_set(friends, pos, posval)
+    friends = _col_set(friends, lastpos,
+                       jnp.full(cnt.shape, -1, I32), rm)
+    cnt = cnt - rm.astype(I32)
+    return friends, cnt, nf, rp
+
+
+def process_makeup_slot(fanin, friends, cnt, src, has, kk):
+    """One mailbox slot of makeup decisions (simulator.go:66-75): accept
+    under fanin, else evict a uniform-random existing friend (to whom a
+    breakup must be sent) and take its slot.  Shared like
+    process_breakup_slot.  Returns (friends, cnt, victim_dst,
+    victim_mask)."""
+    k = friends.shape[1]
+    under = cnt < fanin
+    app = has & under
+    appcol = jnp.minimum(cnt, k - 1)
+    friends = _col_set(friends, appcol, src, app)
+    cnt = cnt + app.astype(I32)
+    ev = has & ~under
+    vpos = jax.random.randint(kk, cnt.shape, 0, jnp.maximum(cnt, 1),
+                              dtype=I32)
+    victim = _col_get(friends, vpos)
+    friends = _col_set(friends, vpos, src, ev)
+    return friends, cnt, victim, ev
 
 
 def make_round_fn(cfg: Config,
@@ -88,12 +162,18 @@ def make_round_fn(cfg: Config,
     cap = cfg.mailbox_cap_resolved
     em, eb = cap + 2, cap
     if deliver_fn is None:
+        # Emission lists are mostly empty once membership settles: compact
+        # before the delivery sort.  Swept on v5e at n=1e6 (full
+        # construction, warm executables): chunk n:17.6s, 131k:13.2s,
+        # 65k:9.6s, 32k:11.4s -- narrow chunks win because per-chunk sort/
+        # scatter width dominates the extra first_true_indices passes of
+        # the bootstrap burst.  -compact-chunk overrides.
+        dchunk = cfg.compact_chunk if cfg.compact_chunk > 0 \
+            else min(max(4096, n), 65536)
+
         def deliver_fn(src, dst, valid, cap):
-            # Emission lists are mostly empty once membership settles:
-            # compact before the delivery sort (chunk ~n keeps the worst
-            # bootstrap round at ~2 passes).
             mbox, _, dropped = deliver(src, dst, valid, n, cap,
-                                       compact_chunk=max(4096, n))
+                                       compact_chunk=dchunk)
             return mbox, dropped
     if ids_fn is None:
         ids_fn = lambda: jnp.arange(n, dtype=I32)
@@ -127,23 +207,10 @@ def make_round_fn(cfg: Config,
             friends, cnt, mk_em, win_bk = carry
             src = bk_mbox[:, slot]
             has = src >= 0
-            in_range = jnp.arange(k, dtype=I32)[None, :] < cnt[:, None]
-            match = (friends == src[:, None]) & in_range & has[:, None]
-            found = match.any(axis=1)
-            pos = jnp.argmax(match, axis=1).astype(I32)  # first match
-            over = cnt > fanout
-            rm = has & found & over
-            rp = has & found & ~over
             kk = jax.random.fold_in(
                 jax.random.fold_in(rkey, _rng.OP_REPLACE), slot)
-            nf = _rng.randint_excluding(kk, n, (n_local,), src, ids)
-            lastpos = jnp.maximum(cnt - 1, 0)
-            lastval = friends[rows, lastpos]
-            posval = jnp.where(rm, lastval, jnp.where(rp, nf, friends[rows, pos]))
-            friends = friends.at[rows, pos].set(posval)
-            friends = _masked_set(friends, rows, lastpos,
-                                  jnp.full((n_local,), -1, I32), rm)
-            cnt = cnt - rm.astype(I32)
+            friends, cnt, nf, rp = process_breakup_slot(
+                n, fanout, friends, cnt, src, has, ids, kk)
             mk_em = mk_em.at[:, slot].set(jnp.where(rp, nf, -1))
             return friends, cnt, mk_em, win_bk + has.sum(dtype=I32)
 
@@ -162,18 +229,10 @@ def make_round_fn(cfg: Config,
             friends, cnt, bk_em, win_mk = carry
             src = mk_mbox[:, slot]
             has = src >= 0
-            under = cnt < fanin
-            app = has & under
-            appcol = jnp.minimum(cnt, k - 1)
-            friends = _masked_set(friends, rows, appcol, src, app)
-            cnt = cnt + app.astype(I32)
-            ev = has & ~under
             kk = jax.random.fold_in(
                 jax.random.fold_in(rkey, _rng.OP_EVICT), slot)
-            vpos = jax.random.randint(kk, (n_local,), 0, jnp.maximum(cnt, 1),
-                                      dtype=I32)
-            victim = friends[rows, vpos]
-            friends = _masked_set(friends, rows, vpos, src, ev)
+            friends, cnt, victim, ev = process_makeup_slot(
+                fanin, friends, cnt, src, has, kk)
             bk_em = bk_em.at[:, slot].set(jnp.where(ev, victim, -1))
             return friends, cnt, bk_em, win_mk + has.sum(dtype=I32)
 

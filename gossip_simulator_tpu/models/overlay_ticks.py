@@ -44,6 +44,8 @@ import jax
 import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models.overlay import (process_breakup_slot,
+                                                 process_makeup_slot)
 from gossip_simulator_tpu.ops.mailbox import deliver
 from gossip_simulator_tpu.ops.select import first_true_indices
 from gossip_simulator_tpu.utils import rng as _rng
@@ -237,7 +239,7 @@ def make_step_fn(cfg: Config):
         rkey = _rng.tick_key(base_key, w, _rng.OP_REPLACE)
         ekey = _rng.tick_key(base_key, w, _rng.OP_EVICT)
         ids = jnp.arange(n, dtype=I32)
-        rows = ids
+
         friends, cnt = st.friends, st.friend_cnt
         mk_em_dst = jnp.full((n, cap_mb), -1, I32)
         mk_em_toff = jnp.zeros((n, cap_mb), I32)
@@ -247,29 +249,18 @@ def make_step_fn(cfg: Config):
         win_bk = jnp.zeros((), I32)
 
         # --- breakups (simulator.go:76-94), slot-sequential ---------------
+        # Decision rules are the SHARED kernels (overlay.process_*_slot);
+        # this engine only threads the trigger's arrival tick through to
+        # the emission so the reply's delay starts at the right time.
         def bk_body(sl, carry):
             friends, cnt, mk_em_dst, mk_em_toff, win_bk = carry
             pay = bk_mbox[:, sl]
             has = pay >= 0
             src = jnp.where(has, pay // b, 0)
             toff = jnp.where(has, pay % b, 0)
-            in_range = jnp.arange(k, dtype=I32)[None, :] < cnt[:, None]
-            match = (friends == src[:, None]) & in_range & has[:, None]
-            found = match.any(axis=1)
-            pos = jnp.argmax(match, axis=1).astype(I32)  # first match
-            over = cnt > fanout
-            rm = has & found & over
-            rp = has & found & ~over
             kk = jax.random.fold_in(rkey, sl)
-            nf = _rng.randint_excluding(kk, n, (n,), src, ids)
-            lastpos = jnp.maximum(cnt - 1, 0)
-            lastval = friends[rows, lastpos]
-            posval = jnp.where(rm, lastval,
-                               jnp.where(rp, nf, friends[rows, pos]))
-            friends = friends.at[rows, pos].set(posval)
-            friends = friends.at[rows, lastpos].set(
-                jnp.where(rm, -1, friends[rows, lastpos]))
-            cnt = cnt - rm.astype(I32)
+            friends, cnt, nf, rp = process_breakup_slot(
+                n, fanout, friends, cnt, src, has, ids, kk)
             mk_em_dst = mk_em_dst.at[:, sl].set(jnp.where(rp, nf, -1))
             mk_em_toff = mk_em_toff.at[:, sl].set(toff)
             return (friends, cnt, mk_em_dst, mk_em_toff,
@@ -287,20 +278,9 @@ def make_step_fn(cfg: Config):
             has = pay >= 0
             src = jnp.where(has, pay // b, 0)
             toff = jnp.where(has, pay % b, 0)
-            under = cnt < fanin
-            app = has & under
-            appcol = jnp.minimum(cnt, k - 1)
-            cur = friends[rows, appcol]
-            friends = friends.at[rows, appcol].set(
-                jnp.where(app, src, cur))
-            cnt = cnt + app.astype(I32)
-            ev = has & ~under
             kk = jax.random.fold_in(ekey, sl)
-            vpos = jax.random.randint(kk, (n,), 0, jnp.maximum(cnt, 1),
-                                      dtype=I32)
-            victim = friends[rows, vpos]
-            friends = friends.at[rows, vpos].set(
-                jnp.where(ev, src, victim))
+            friends, cnt, victim, ev = process_makeup_slot(
+                fanin, friends, cnt, src, has, kk)
             bk_em_dst = bk_em_dst.at[:, sl].set(jnp.where(ev, victim, -1))
             bk_em_toff = bk_em_toff.at[:, sl].set(toff)
             return (friends, cnt, bk_em_dst, bk_em_toff,
@@ -328,9 +308,15 @@ def make_step_fn(cfg: Config):
             win_breakups=st.win_breakups + win_bk,
             mailbox_dropped=dropped)
 
+    # Delivery compaction chunk: same 64k optimum (and -compact-chunk
+    # override) as the round engine's deliver_fn -- see the sweep note in
+    # overlay.make_round_fn.
+    dchunk = cfg.compact_chunk if cfg.compact_chunk > 0 \
+        else min(max(4096, cfg.n), 65536)
+
     def _deliver(src_pay, dst, valid):
         mbox, count, drp = deliver(src_pay, dst, valid, n, cap_mb,
-                                   compact_chunk=max(4096, n))
+                                   compact_chunk=dchunk)
         return mbox, drp, count
 
     return step_fn
