@@ -78,9 +78,18 @@ class ShardedStepper(Stepper):
             self.state = None
             self._overlay_done = True
         elif cfg.graph == "overlay":
-            self._oround = sharded_step.make_overlay_round_fn(cfg, self.mesh)
-            self.ostate = sharded_step.make_sharded_overlay_init(
-                cfg, self.mesh)()
+            self._faithful_overlay = cfg.overlay_mode == "ticks"
+            if self._faithful_overlay:
+                from gossip_simulator_tpu.parallel import \
+                    overlay_ticks_sharded as ots
+
+                self._oround = ots.make_poll_fn(cfg, self.mesh)
+                self.ostate = ots.make_sharded_init(cfg, self.mesh)(self.key)
+            else:
+                self._oround = sharded_step.make_overlay_round_fn(
+                    cfg, self.mesh)
+                self.ostate = sharded_step.make_sharded_overlay_init(
+                    cfg, self.mesh)()
             self._overlay_done = False
             self.state = None
         else:
@@ -94,13 +103,24 @@ class ShardedStepper(Stepper):
             return 0, 0, True
         self.ostate = self._oround(self.ostate, self.key)
         self._overlay_rounds += 1
-        mk, bk, q = jax.device_get(
+        faithful = getattr(self, "_faithful_overlay", False)
+        if faithful:
+            from gossip_simulator_tpu.models import overlay_ticks
+
+            quiesced = overlay_ticks.quiesced(self.ostate)
+            tick = self.ostate.tick
+        else:
+            quiesced = overlay.quiesced(self.ostate)
+            tick = 0
+        mk, bk, q, tick = jax.device_get(
             (self.ostate.win_makeups, self.ostate.win_breakups,
-             overlay.quiesced(self.ostate)))
+             quiesced, tick))
+        self._phase1_ms = (float(tick) if faithful
+                           else self._overlay_rounds * self._mean_delay)
         if bool(q):
             self._overlay_done = True
             # Freeze phase-1 elapsed time (see JaxStepper.overlay_window).
-            self._stabilize_ms = self._overlay_rounds * self._mean_delay
+            self._stabilize_ms = self._phase1_ms
             self._mailbox_dropped = int(
                 jax.device_get(self.ostate.mailbox_dropped))
             self.state = self._epidemic_from_overlay()
@@ -177,7 +197,8 @@ class ShardedStepper(Stepper):
 
     def sim_time_ms(self) -> float:
         if self.state is None or not self._overlay_done:
-            return self._overlay_rounds * self._mean_delay
+            return getattr(self, "_phase1_ms",
+                           self._overlay_rounds * self._mean_delay)
         if not getattr(self, "_seeded", False):
             # Between quiescence and the broadcast: phase-1 elapsed time.
             return getattr(self, "_stabilize_ms", 0.0)

@@ -108,10 +108,10 @@ class Config:
     # into synchronous rounds, delivering every emission exactly one round
     # later and ESTIMATING stabilization time as rounds x mean_delay;
     # "ticks" keeps the reference's per-message uniform delays through a
-    # packed window-slot ring (models/overlay_ticks.py) so the
-    # stabilization clock is true simulated ms (simulator.go:151-168).
-    # "ticks" is jax-backend-only for now; native/cpp are inherently
-    # faithful (discrete-event).
+    # packed window-slot ring (models/overlay_ticks.py, sharded variant
+    # parallel/overlay_ticks_sharded.py) so the stabilization clock is
+    # true simulated ms (simulator.go:151-168).  native/cpp are inherently
+    # faithful (discrete-event) and ignore the flag.
     overlay_mode: str = "rounds"
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
@@ -274,11 +274,8 @@ class Config:
         if self.overlay_mode == "ticks" and self.graph == "overlay":
             # native/cpp are discrete-event and inherently faithful, so the
             # flag is a no-op there; only the vectorized backends gate.
-            if self.backend == "sharded":
-                raise ValueError(
-                    "-overlay-mode ticks is jax-backend-only for now "
-                    "(the sharded overlay runs in rounds mode)")
-            if self.backend == "jax" and self.effective_time_mode != "ticks":
+            if (self.backend in ("jax", "sharded")
+                    and self.effective_time_mode != "ticks"):
                 raise ValueError(
                     "-overlay-mode ticks requires -time-mode ticks")
         if self.distributed:

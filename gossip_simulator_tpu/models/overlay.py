@@ -62,6 +62,18 @@ def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     )
 
 
+def delivery_chunk(cfg: Config, n_rows: int) -> int:
+    """Delivery-compaction chunk for the overlay mailbox deliver: 64k
+    optimum from the v5e full-construction sweep (chunk n: 17.6s,
+    131k: 13.2s, 65k: 9.6s, 32k: 11.4s at n=1e6 -- narrow chunks win
+    because per-chunk sort/scatter width dominates the extra
+    first_true_indices passes of the bootstrap burst); -compact-chunk
+    overrides.  One definition for the rounds engine, the tick-faithful
+    engine and their sharded variants."""
+    return cfg.compact_chunk if cfg.compact_chunk > 0 \
+        else min(max(4096, n_rows), 65536)
+
+
 def _col_onehot(cols, k: int):
     """bool[n, k]: row r's `cols[r]` column.  The friends width k is tiny
     (~6), so per-row column reads/writes are ONE-HOT ELEMENTWISE ops, not
@@ -163,13 +175,8 @@ def make_round_fn(cfg: Config,
     em, eb = cap + 2, cap
     if deliver_fn is None:
         # Emission lists are mostly empty once membership settles: compact
-        # before the delivery sort.  Swept on v5e at n=1e6 (full
-        # construction, warm executables): chunk n:17.6s, 131k:13.2s,
-        # 65k:9.6s, 32k:11.4s -- narrow chunks win because per-chunk sort/
-        # scatter width dominates the extra first_true_indices passes of
-        # the bootstrap burst.  -compact-chunk overrides.
-        dchunk = cfg.compact_chunk if cfg.compact_chunk > 0 \
-            else min(max(4096, n), 65536)
+        # before the delivery sort (chunk sweep: see delivery_chunk).
+        dchunk = delivery_chunk(cfg, n)
 
         def deliver_fn(src, dst, valid, cap):
             mbox, _, dropped = deliver(src, dst, valid, n, cap,

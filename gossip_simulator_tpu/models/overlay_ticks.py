@@ -44,7 +44,8 @@ import jax
 import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
-from gossip_simulator_tpu.models.overlay import (process_breakup_slot,
+from gossip_simulator_tpu.models.overlay import (delivery_chunk,
+                                                 process_breakup_slot,
                                                  process_makeup_slot)
 from gossip_simulator_tpu.ops.mailbox import deliver
 from gossip_simulator_tpu.ops.select import first_true_indices
@@ -70,21 +71,25 @@ def ring_windows(cfg: Config) -> int:
     return (b - 1 + cfg.delayhigh - 1) // b + 1
 
 
-def slot_cap(cfg: Config) -> int:
-    """Packed entries per window slot.  Peak traffic is the bootstrap burst
-    (n*fanout makeups) spread over the delay span, plus a comparable
-    response wave; 2x covers skew.  Overflow is counted, never silent."""
+def slot_cap(cfg: Config, n_local: int | None = None) -> int:
+    """Packed entries per window slot (per shard: destinations are uniform,
+    so a shard's share of the traffic scales with its row count).  Peak
+    traffic is the bootstrap burst (n*fanout makeups) spread over the delay
+    span, plus a comparable response wave; 2x covers skew.  Overflow is
+    counted, never silent."""
+    n = n_local if n_local is not None else cfg.n
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
     cap = max(4096, int(math.ceil(
-        2.0 * cfg.n * cfg.fanout * b / max(cfg.delay_span, 1))))
+        2.0 * n * cfg.fanout * b / max(cfg.delay_span, 1))))
     cap = min(cap, (3 * 2**30) // (8 * max(dw, 1)))  # ~3 GB for both arrays
     return min(cap, (2**31 - 2) // max(dw, 1))
 
 
-def emit_chunk(cfg: Config) -> int:
+def emit_chunk(cfg: Config, n_local: int | None = None) -> int:
     """Emission-compaction chunk (the drain_chunk analog)."""
-    return min(slot_cap(cfg), max(4096, min(262_144, cfg.n // 8)))
+    n = n_local if n_local is not None else cfg.n
+    return min(slot_cap(cfg, n_local), max(4096, min(262_144, n // 8)))
 
 
 class OverlayTickState(NamedTuple):
@@ -208,14 +213,44 @@ def _emit_all(cfg: Config, st_ring, base_key, w, em_dst, em_toff, typ, op):
     return out[:4]
 
 
-def make_step_fn(cfg: Config):
-    """One B-tick window transition (drain -> deliver -> process -> emit)."""
+def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
+                 key_fn=None, sum_fn=None, emit_fn=None):
+    """One B-tick window transition (drain -> deliver -> process -> emit).
+
+    The four hooks make the SAME body run single-device or per-shard inside
+    shard_map (parallel/overlay_ticks_sharded.py), mirroring
+    overlay.make_round_fn's hook pattern so the two modes cannot diverge:
+      ids_fn() -> global ids of the local rows (arange by default).
+      key_fn(base_key, w, op) -> per-window op key (the sharded variant
+          folds the shard index in first to decorrelate draws).
+      sum_fn(x) -> global scalar reduction (identity / psum).
+      emit_fn(ring, base_key, w, em_dst, em_toff, typ, op) -> ring, with
+          `ring = (ring_dst, ring_pay, ring_cnt, local_dropped)`: local
+          append by default, route-then-append when sharded.
+    """
     n, k = cfg.n, cfg.max_degree
+    n_rows = n_local if n_local is not None else cfg.n
     fanout, fanin = cfg.fanout, cfg.fanin_resolved
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
-    cap = slot_cap(cfg)
+    cap = slot_cap(cfg, n_local)
     cap_mb = cfg.mailbox_cap_resolved
+    dchunk = delivery_chunk(cfg, n_rows)
+    if ids_fn is None:
+        ids_fn = lambda: jnp.arange(n_rows, dtype=I32)
+    if key_fn is None:
+        key_fn = _rng.tick_key
+    if sum_fn is None:
+        sum_fn = lambda x: x
+    if emit_fn is None:
+        def emit_fn(ring, base_key, w, em_dst, em_toff, typ, op):
+            return _emit_all(cfg, ring, base_key, w, em_dst, em_toff,
+                             typ, op)
+
+    def _deliver(src_pay, dst, valid):
+        mbox, _, drp = deliver(src_pay, dst, valid, n_rows, cap_mb,
+                               compact_chunk=dchunk)
+        return mbox, drp
 
     def step_fn(st: OverlayTickState, base_key: jax.Array) -> OverlayTickState:
         w = st.tick // b
@@ -231,20 +266,20 @@ def make_step_fn(cfg: Config):
         evalid = toff_key < b
         typ = (pay_e // b) % 2
         mbox_pay = (pay_e // (2 * b)) * b + pay_e % b  # src*b + toff
-        mk_mbox, drop1, _ = _deliver(mbox_pay, dst_e, evalid & (typ == MK))
-        bk_mbox, drop2, _ = _deliver(mbox_pay, dst_e, evalid & (typ == BK))
-        dropped = st.mailbox_dropped + drop1 + drop2
+        mk_mbox, drop1 = _deliver(mbox_pay, dst_e, evalid & (typ == MK))
+        bk_mbox, drop2 = _deliver(mbox_pay, dst_e, evalid & (typ == BK))
+        local_drops = drop1 + drop2
         ring_cnt = st.ring_cnt.at[0, slot].set(0)
 
-        rkey = _rng.tick_key(base_key, w, _rng.OP_REPLACE)
-        ekey = _rng.tick_key(base_key, w, _rng.OP_EVICT)
-        ids = jnp.arange(n, dtype=I32)
+        rkey = key_fn(base_key, w, _rng.OP_REPLACE)
+        ekey = key_fn(base_key, w, _rng.OP_EVICT)
+        ids = ids_fn()
 
         friends, cnt = st.friends, st.friend_cnt
-        mk_em_dst = jnp.full((n, cap_mb), -1, I32)
-        mk_em_toff = jnp.zeros((n, cap_mb), I32)
-        bk_em_dst = jnp.full((n, cap_mb), -1, I32)
-        bk_em_toff = jnp.zeros((n, cap_mb), I32)
+        mk_em_dst = jnp.full((n_rows, cap_mb), -1, I32)
+        mk_em_toff = jnp.zeros((n_rows, cap_mb), I32)
+        bk_em_dst = jnp.full((n_rows, cap_mb), -1, I32)
+        bk_em_toff = jnp.zeros((n_rows, cap_mb), I32)
         win_mk = jnp.zeros((), I32)
         win_bk = jnp.zeros((), I32)
 
@@ -292,13 +327,15 @@ def make_step_fn(cfg: Config):
             (friends, cnt, bk_em_dst, bk_em_toff, win_mk))
 
         # --- emissions -> ring, per-message delays -------------------------
-        ring = (st.ring_dst, st.ring_pay, ring_cnt, dropped)
-        ring = _emit_all(cfg, ring, base_key, w, mk_em_dst, mk_em_toff,
-                         MK, _rng.OP_DELAY)
-        ring = _emit_all(cfg, ring, base_key, w, bk_em_dst, bk_em_toff,
-                         BK, _rng.OP_DELAY_BK)
-        ring_dst, ring_pay, ring_cnt, dropped = ring
+        ring = (st.ring_dst, st.ring_pay, ring_cnt, local_drops)
+        ring = emit_fn(ring, base_key, w, mk_em_dst, mk_em_toff,
+                       MK, _rng.OP_DELAY)
+        ring = emit_fn(ring, base_key, w, bk_em_dst, bk_em_toff,
+                       BK, _rng.OP_DELAY_BK)
+        ring_dst, ring_pay, ring_cnt, local_drops = ring
 
+        win_mk = sum_fn(win_mk)
+        win_bk = sum_fn(win_bk)
         return OverlayTickState(
             friends=friends, friend_cnt=cnt,
             ring_dst=ring_dst, ring_pay=ring_pay, ring_cnt=ring_cnt,
@@ -306,18 +343,7 @@ def make_step_fn(cfg: Config):
             makeups=st.makeups + win_mk, breakups=st.breakups + win_bk,
             win_makeups=st.win_makeups + win_mk,
             win_breakups=st.win_breakups + win_bk,
-            mailbox_dropped=dropped)
-
-    # Delivery compaction chunk: same 64k optimum (and -compact-chunk
-    # override) as the round engine's deliver_fn -- see the sweep note in
-    # overlay.make_round_fn.
-    dchunk = cfg.compact_chunk if cfg.compact_chunk > 0 \
-        else min(max(4096, cfg.n), 65536)
-
-    def _deliver(src_pay, dst, valid):
-        mbox, count, drp = deliver(src_pay, dst, valid, n, cap_mb,
-                                   compact_chunk=dchunk)
-        return mbox, drp, count
+            mailbox_dropped=st.mailbox_dropped + sum_fn(local_drops))
 
     return step_fn
 

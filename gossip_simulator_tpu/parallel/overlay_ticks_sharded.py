@@ -1,0 +1,205 @@
+"""Sharded tick-faithful overlay construction (-overlay-mode ticks,
+backend=sharded): models/overlay_ticks.py over the node mesh.
+
+Each shard owns a contiguous row slice and its own packed window ring;
+emissions are routed to their destination's shard with one all_to_all per
+compaction chunk (parallel/exchange.route_multi), window counters are
+psum'd (replicated, so the quiescence predicate agrees on every shard), and
+the membership decision rules are the SAME shared kernels the single-device
+engines use (overlay.process_breakup_slot / process_makeup_slot).
+
+The bootstrap burst and its delays are keyed by GLOBAL row / emission
+index, so the initial friends table and the initial in-flight messages are
+bit-identical to a single-device run's -- only their placement differs.
+Later processing draws are per-shard streams (like the sharded rounds
+overlay), so trajectories diverge from single-device statistically, not
+structurally; parity is validated by the same degree-distribution and
+stabilization-clock tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models import overlay_ticks as ot
+from gossip_simulator_tpu.ops.mailbox import ring_append
+from gossip_simulator_tpu.ops.select import first_true_indices
+from gossip_simulator_tpu.parallel import exchange
+from gossip_simulator_tpu.parallel.mesh import AXIS, shard_size
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+
+
+def overlay_tick_state_specs() -> ot.OverlayTickState:
+    return ot.OverlayTickState(
+        friends=P(AXIS, None), friend_cnt=P(AXIS),
+        ring_dst=P(AXIS), ring_pay=P(AXIS), ring_cnt=P(AXIS, None),
+        tick=P(), makeups=P(), breakups=P(),
+        win_makeups=P(), win_breakups=P(), mailbox_dropped=P())
+
+
+def _shard_map(mesh, fn, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def _route_append(cfg, n_local, s, ring, dst_g, pay, wslot, valid, rcap):
+    """Route (global dst, pay, wslot) entries to their owner shards and
+    append into the local ring (entries store LOCAL destinations)."""
+    ring_dst, ring_pay, ring_cnt, dropped = ring
+    dw = ot.ring_windows(cfg)
+    cap = (ring_dst.shape[0] - 1) // dw
+    (rd, rp, rw), ovf = exchange.route_multi(
+        (jnp.where(valid, dst_g % n_local, -1),
+         jnp.where(valid, pay, -1),
+         jnp.where(valid, wslot, -1)),
+        jnp.where(valid, dst_g // n_local, s), valid, s, rcap)
+    rvalid = rd >= 0
+    (ring_dst, ring_pay), ring_cnt, dropped = ring_append(
+        (ring_dst, ring_pay), ring_cnt, dropped + ovf,
+        (jnp.where(rvalid, rd, 0), jnp.where(rvalid, rp, 0)),
+        jnp.where(rvalid, rw, 0), rvalid, dw, cap)
+    return ring_dst, ring_pay, ring_cnt, dropped
+
+
+def make_sharded_init(cfg: Config, mesh):
+    """Per-shard state + the routed window-0 bootstrap burst."""
+    n, f, k = cfg.n, cfg.fanout, cfg.max_degree
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+    b = ot.batch_ticks(cfg)
+    dw = ot.ring_windows(cfg)
+    cap = ot.slot_cap(cfg, n_local)
+    chunk = ot.emit_chunk(cfg, n_local)
+    flat_n = n_local * f
+    rcap = exchange.epidemic_cap(chunk, 1, s)
+
+    def init_shard(base_key):
+        shard = jax.lax.axis_index(AXIS)
+        gids = shard * n_local + jnp.arange(n_local, dtype=I32)
+        kb = _rng.tick_key(base_key, 0, _rng.OP_BOOTSTRAP)
+        # Global row keys: the same friends table a single-device run draws.
+        w = jax.vmap(
+            lambda kk: jax.random.randint(kk, (f,), 0, n, dtype=I32))(
+            _rng.row_keys(kb, gids))
+        w = jnp.where(w == gids[:, None], (w + 1) % n, w)
+        friends = jnp.full((n_local, k), -1, I32).at[:, :f].set(w)
+        cnt = jnp.full((n_local,), f, I32)
+        ring_dst = jnp.zeros((dw * cap + 1,), I32)
+        ring_pay = jnp.zeros((dw * cap + 1,), I32)
+        ring_cnt = jnp.zeros((1, dw), I32)
+        kd = _rng.tick_key(base_key, 0, _rng.OP_DELAY)
+
+        def body(i, carry):
+            idx = i * chunk + jnp.arange(chunk, dtype=I32)
+            valid = idx < flat_n
+            src_g = jnp.where(valid, shard * n_local + idx // f, 0)
+            dst = w.reshape(-1).at[jnp.where(valid, idx, 0)].get()
+            # Global emission index -> the single-device burst's delays.
+            delay = _rng.row_uniform_delay(
+                kd, cfg.delaylow, cfg.delayhigh,
+                jnp.where(valid, shard * flat_n + idx, n * f))
+            arrive = delay  # emitted at t=0
+            return _route_append(
+                cfg, n_local, s, carry, jnp.where(valid, dst, 0),
+                (src_g * 2 + ot.MK) * b + arrive % b,
+                (arrive // b) % dw, valid, rcap)
+
+        z = jnp.zeros((), I32)
+        ring_dst, ring_pay, ring_cnt, dropped = jax.lax.fori_loop(
+            0, -(-flat_n // chunk), body,
+            (ring_dst, ring_pay, ring_cnt, z))
+        return ot.OverlayTickState(
+            friends=friends, friend_cnt=cnt,
+            ring_dst=ring_dst, ring_pay=ring_pay, ring_cnt=ring_cnt,
+            tick=z, makeups=z, breakups=z,
+            win_makeups=z, win_breakups=z,
+            mailbox_dropped=jax.lax.psum(dropped, AXIS))
+
+    specs = overlay_tick_state_specs()
+    return jax.jit(_shard_map(mesh, init_shard, in_specs=(P(),),
+                              out_specs=specs))
+
+
+def make_poll_fn(cfg: Config, mesh):
+    """One 10 ms poll window as one jitted shard_map call.  The step body
+    is the single-device engine's (overlay_ticks.make_step_fn) with the
+    four backend hooks supplied here -- global row ids, shard-folded key
+    streams, psum reductions, and route-then-append emissions -- so the
+    two -overlay-mode ticks engines share every line of sequencing and
+    decision logic."""
+    n = cfg.n
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+    b = ot.batch_ticks(cfg)
+    dw = ot.ring_windows(cfg)
+    cap_mb = cfg.mailbox_cap_resolved
+    echunk = ot.emit_chunk(cfg, n_local)
+    rcap = exchange.epidemic_cap(echunk, 1, s)
+    steps = max(1, -(-10 // b))
+
+    def emit_routed(ring, base_key, w, em_dst, em_toff, typ, op):
+        """Compact a local (n_local, cap_mb) emission buffer, draw
+        per-message delays (keyed by global emission index) and route each
+        entry to its destination's shard."""
+        shard = jax.lax.axis_index(AXIS)
+        flat_n = n_local * cap_mb
+        dflat = em_dst.reshape(-1)
+        tflat = em_toff.reshape(-1)
+        valid_all = dflat >= 0
+        # Chunk count must agree across shards: the loop body routes.
+        total = jax.lax.pmax(valid_all.sum(dtype=I32), AXIS)
+        kd = _rng.tick_key(base_key, w, op)
+
+        def body(_, carry):
+            ring, remaining = carry
+            idx = first_true_indices(remaining, echunk)
+            hit = jnp.zeros((flat_n,), bool).at[idx].set(True, mode="drop")
+            remaining = remaining & ~hit
+            okx = idx < flat_n
+            src_g = jnp.where(okx, shard * n_local + idx // cap_mb, 0)
+            dst = dflat.at[idx].get(mode="fill", fill_value=-1)
+            toff = tflat.at[idx].get(mode="fill", fill_value=0)
+            valid = dst >= 0
+            delay = _rng.row_uniform_delay(
+                kd, cfg.delaylow, cfg.delayhigh,
+                jnp.where(okx, shard * flat_n + idx, s * flat_n))
+            arrive = w * b + toff + delay
+            ring = _route_append(
+                cfg, n_local, s, ring, jnp.where(valid, dst, 0),
+                (src_g * 2 + typ) * b + arrive % b,
+                (arrive // b) % dw, valid, rcap)
+            return ring, remaining
+
+        (ring, _) = jax.lax.fori_loop(
+            0, (total + echunk - 1) // echunk, body, (ring, valid_all))
+        return ring
+
+    def ids_fn():
+        shard = jax.lax.axis_index(AXIS)
+        return shard * n_local + jnp.arange(n_local, dtype=I32)
+
+    def key_fn(base_key, w, op):
+        shard = jax.lax.axis_index(AXIS)
+        return _rng.tick_key(jax.random.fold_in(base_key, shard), w, op)
+
+    def sum_fn(x):
+        return jax.lax.psum(x, AXIS)
+
+    step = ot.make_step_fn(cfg, n_local=n_local, ids_fn=ids_fn,
+                           key_fn=key_fn, sum_fn=sum_fn,
+                           emit_fn=emit_routed)
+
+    def poll_shard(st: ot.OverlayTickState, base_key):
+        st = st._replace(win_makeups=jnp.zeros((), I32),
+                         win_breakups=jnp.zeros((), I32))
+        return jax.lax.fori_loop(
+            0, steps, lambda _, x: step(x, base_key), st)
+
+    specs = overlay_tick_state_specs()
+    return jax.jit(_shard_map(mesh, poll_shard, in_specs=(specs, P()),
+                              out_specs=specs), donate_argnums=(0,))

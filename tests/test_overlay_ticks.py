@@ -113,9 +113,41 @@ def test_end_to_end_epidemic_handoff():
 
 
 def test_validation():
-    with pytest.raises(ValueError, match="jax-backend-only"):
-        Config(**{**BASE, "backend": "sharded", "n": 1200}).validate()
     with pytest.raises(ValueError, match="time-mode ticks"):
         Config(**{**BASE, "time_mode": "rounds"}).validate()
     # Irrelevant for static graphs: accepted and ignored.
     Config(**{**BASE, "graph": "kout"}).validate()
+
+
+def test_sharded_quiesces_and_matches_clock_scale():
+    """Sharded faithful overlay on the 8-device mesh: routed emissions,
+    psum'd counters, and a stabilization clock in the oracle's range."""
+    from gossip_simulator_tpu.backends.sharded import ShardedStepper
+
+    cfg = Config(**{**BASE, "backend": "sharded", "n": 2000}).validate()
+    s = ShardedStepper(cfg)
+    s.init()
+    assert _stabilize(s)
+    cnt = np.asarray(s.ostate.friend_cnt if s.ostate is not None
+                     else s.state.friend_cnt)
+    assert (cnt >= cfg.fanout).all()
+    assert (cnt <= cfg.max_degree).all()
+    assert s._mailbox_dropped == 0
+    o = NativeStepper(cfg.replace(backend="native", overlay_mode="rounds"))
+    o.init()
+    for _ in range(10_000):
+        if o.overlay_window()[2]:
+            break
+    assert 0.5 <= s._stabilize_ms / o.sim_time_ms() <= 2.0
+
+
+def test_sharded_end_to_end_and_determinism():
+    kw = {**BASE, "backend": "sharded", "n": 2000, "coverage_target": 0.9}
+    r1 = run_simulation(Config(**kw).validate(),
+                        printer=ProgressPrinter(enabled=False))
+    r2 = run_simulation(Config(**kw).validate(),
+                        printer=ProgressPrinter(enabled=False))
+    assert r1.converged
+    assert r1.stats == r2.stats
+    assert r1.stabilize_ms == r2.stabilize_ms
+    assert r1.stats.exchange_overflow == 0
