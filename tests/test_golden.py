@@ -1,13 +1,15 @@
-"""Golden-output compat tests: the full stdout of tiny -compat-reference
-runs, byte-exact against checked-in transcripts.
+"""Golden-output tests: full CLI stdout, byte-exact against checked-in
+transcripts.
 
 Pins the complete observable surface of SURVEY §0's output contract in one
 place: the alphabetical parameter dump with ms suffixes (simulator.go:
 197-204), the `elasped` typo windows (230), the stabilize/99% summaries with
 Go-style duration rendering -- `280ms` vs `7.12s` (235, 252; metrics.
-fmt_sim_ms), and the final totals line (253) with Total Crashed 0 under the
-compat 1%-resolution truncation.  Regenerate with the commands in each
-golden file's test after an INTENTIONAL format change; any other diff is a
+fmt_sim_ms), and the final totals line (253).  The two -compat-reference
+runs additionally pin Total Crashed 0 under the compat 1%-resolution
+truncation; the -overlay-mode ticks run pins the faithful phase-1
+transcript (no compat gate).  Regenerate with the commands in each golden
+file's test after an INTENTIONAL format change; any other diff is a
 regression.
 """
 
@@ -37,6 +39,17 @@ def test_compat_reference_small_byte_exact():
     out = _run_cli("-n", "800", "-backend", "native", "-seed", "7",
                    "-compat-reference")
     assert out == _golden("compat_small.txt")
+
+
+def test_overlay_ticks_byte_exact():
+    """Faithful phase-1 (-overlay-mode ticks) full transcript: pins the
+    window-0 bootstrap burst (n*fanout makeups processed as they arrive),
+    the per-window membership counts and the true-ms stabilization clock
+    of the packed-ring engine (models/overlay_ticks.py)."""
+    out = _run_cli("-n", "1000", "-backend", "jax", "-graph", "overlay",
+                   "-overlay-mode", "ticks", "-fanout", "5", "-seed", "9",
+                   "-coverage-target", "0.9")
+    assert out == _golden("overlay_ticks.txt")
 
 
 def test_compat_reference_seconds_rendering_byte_exact():

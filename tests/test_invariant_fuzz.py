@@ -27,8 +27,8 @@ def _random_cfg(i: int) -> Config:
     if engine == "event":
         time_mode = "ticks"
     # The faithful phase-1 engine only engages for graph=overlay in ticks
-    # time mode (pushpull forces rounds) -- a combination the 12 base
-    # seeds happen never to draw, so dedicated case ids force it (one
+    # time mode (pushpull forces rounds) -- a combination the base seeds
+    # are not guaranteed to draw, so dedicated case ids force it (one
     # jax, one sharded; checked by test_faithful_overlay_cases_engage).
     if i in FAITHFUL_CASES:
         graph, time_mode, overlay_mode = "overlay", "ticks", "ticks"
@@ -75,7 +75,11 @@ def test_counter_algebra_holds_sharded(i):
 
 
 def test_faithful_overlay_cases_engage():
-    """Guard against the forced cases silently decaying into no-ops."""
+    """Guard against the forced cases silently decaying into no-ops --
+    both the config fields AND their membership in the executed
+    parametrize ranges (a resized sweep must keep covering them)."""
+    assert FAITHFUL_CASES[0] in range(8)  # test_counter_algebra_holds
+    assert FAITHFUL_CASES[1] in range(8, 12)  # ..._holds_sharded
     for i in FAITHFUL_CASES:
         cfg = _random_cfg(i)
         assert cfg.graph == "overlay" and cfg.overlay_mode == "ticks"
