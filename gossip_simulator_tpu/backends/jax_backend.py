@@ -109,6 +109,11 @@ class JaxStepper(Stepper):
         cfg = self.cfg
         if cfg.graph == "overlay":
             raise ValueError("reset_state requires a static graph")
+        # Free the old state FIRST: regenerating while the previous
+        # friends table + mail ring are still referenced doubles the HBM
+        # footprint (~12 GB transient at 1e8 x fanout 6 -- enough to crash
+        # a 16 GB v5e worker, observed r2).
+        self.state = None
         friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
         self.state = self._engine.init_state(cfg, friends, cnt)
         self.exhausted = False

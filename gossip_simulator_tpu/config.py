@@ -211,13 +211,29 @@ class Config:
             return "event"
         return "ring"
 
-    @property
-    def mailbox_cap_resolved(self) -> int:
+    def mailbox_cap_for(self, n_rows: int) -> int:
+        """Mailbox capacity for a delivery surface of `n_rows` local rows
+        (the full node axis single-device; one shard's slice sharded --
+        flat int32 addressing is per-LOCAL-array, so a sharded run keeps
+        cap 16 well past the single-device boundary)."""
         if self.mailbox_cap > 0:
             return self.mailbox_cap
         # Balls-in-bins: with <=N uniform messages into N bins the max load is
-        # ~ln N/ln ln N w.h.p.; 16 is comfortably beyond it for any feasible N.
+        # ~ln N/ln ln N w.h.p. (~6.3 at N=1e8); 16 is comfortably beyond it
+        # for any feasible N.  Past n_rows ~ 1.34e8, (n_rows+1)*16 overflows
+        # the flat int32 mailbox addressing and delivery would silently take
+        # the ~15x dense 2-D-scatter path (ops/mailbox.deliver) -- auto-shrink
+        # to 8 there (still above the max-load bound; overflow is counted,
+        # never silent), which keeps flat addressing to n_rows ~ 2.7e8.
+        # Beyond THAT the dense fallback engages and deliver's one-time
+        # warning names it.
+        if (n_rows + 1) * 16 >= 2**31:
+            return 8
         return 16
+
+    @property
+    def mailbox_cap_resolved(self) -> int:
+        return self.mailbox_cap_for(self.n)
 
     def validate(self) -> "Config":
         if self.n < 2:
@@ -238,6 +254,18 @@ class Config:
             )
         if self.delaylow < 0:
             raise ValueError(f"delaylow must be >= 0, got {self.delaylow}")
+        if (self.delaylow < 1 and self.backend in ("jax", "sharded")
+                and self.effective_time_mode == "ticks"):
+            # The delay-ring engines batch B = min(10, delaylow) ticks per
+            # step and clamp drawn delays to >= 1 (a zero-delay message
+            # would land in the ring slot already drained this step); with
+            # delaylow=0 the clamp silently reshapes the delay distribution
+            # instead.  Reject it -- zero-delay networks run faithfully on
+            # the discrete-event backends (native/cpp) or in rounds mode.
+            raise ValueError(
+                "delaylow must be >= 1 in ticks mode on the jax/sharded "
+                "backends (drawn delays are clamped to >= 1 tick); use "
+                "-time-mode rounds or -backend native/cpp for delaylow=0")
         for name in ("droprate", "crashrate", "removal_rate"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:

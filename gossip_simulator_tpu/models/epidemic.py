@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
-from gossip_simulator_tpu.models.state import SimState, msg64_add, msg64_zero
+from gossip_simulator_tpu.models.state import (SimState, in_flight,
+                                               msg64_add, msg64_zero)
 from gossip_simulator_tpu.ops.select import first_true_indices  # noqa: F401  (re-export: compaction callers import it from here)
 from gossip_simulator_tpu.utils import rng as _rng
 
@@ -435,13 +436,24 @@ def make_run_to_coverage_fn(cfg: Config):
     step = make_step_fn(cfg)
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
+    # Push-pull draws fresh random peers each round -- there is no ring
+    # occupancy to test, and the wave never "dies in flight".
+    check_in_flight = cfg.protocol != "pushpull"
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run_fn(st: SimState, base_key: jax.Array, target_count: jax.Array,
                until: jax.Array) -> SimState:
         def cond(s: SimState):
-            return ((s.total_received < target_count)
+            live = ((s.total_received < target_count)
                     & (s.tick < max_steps) & (s.tick < until))
+            if check_in_flight:
+                # In-flight term (an O(d*n) emptiness test per window, not
+                # per tick): exit the device loop the moment the wave dies
+                # instead of spinning empty windows until the bounded-call
+                # budget lets the host notice -- parity with the event
+                # engine's cond (event.make_run_to_coverage_fn).
+                live = live & (in_flight(s) > 0)
+            return live
 
         def body(s: SimState):
             # One window per iteration keeps the predicate check off the

@@ -73,7 +73,10 @@ import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic
-from gossip_simulator_tpu.models.state import msg64_add, msg64_zero
+# in_flight: canonical engine-agnostic definition in models/state.py,
+# re-exported here for the backends that import event.in_flight.
+from gossip_simulator_tpu.models.state import (in_flight,  # noqa: F401
+                                               msg64_add, msg64_zero)
 from gossip_simulator_tpu.utils import rng as _rng
 
 I32 = jnp.int32
@@ -589,7 +592,7 @@ def make_run_to_coverage_fn(cfg: Config):
             # runs between bounded calls).
             return ((s.total_received < target_count)
                     & (s.tick < max_steps) & (s.tick < until)
-                    & jnp.any(s.mail_cnt > 0))
+                    & (in_flight(s) > 0))
 
         def body(s: EventState):
             return jax.lax.fori_loop(
@@ -600,15 +603,6 @@ def make_run_to_coverage_fn(cfg: Config):
     return run_fn
 
 
-def in_flight(st) -> jnp.ndarray:
-    """int32 0/1: nonzero iff any message is still undelivered --
-    engine-agnostic (EventState or the ring engine's SimState).  An
-    indicator, NOT a count: every caller only tests emptiness, and a full
-    count would overflow int32 when summed across shards near ring
-    occupancy (slot_cap clamps each shard to ~2^31 entries)."""
-    if hasattr(st, "mail_cnt"):
-        return jnp.any(st.mail_cnt > 0).astype(I32)
-    return (jnp.any(st.pending > 0) | jnp.any(st.rebroadcast)).astype(I32)
 
 
 def removed_count(st) -> jnp.ndarray:

@@ -49,7 +49,9 @@ I32 = jnp.int32
 def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     n = n_local if n_local is not None else cfg.n
     k = cfg.max_degree
-    cap = cfg.mailbox_cap_resolved
+    # Per-LOCAL-rows cap: one shard's slice keeps cap 16 far beyond the
+    # single-device flat-addressing boundary (config.mailbox_cap_for).
+    cap = cfg.mailbox_cap_for(n)
     em, eb = cap + 2, cap
     z = lambda: jnp.zeros((), I32)
     return OverlayState(
@@ -157,7 +159,8 @@ def process_makeup_slot(fanin, friends, cnt, src, has, kk):
 def make_round_fn(cfg: Config,
                   deliver_fn=None,
                   ids_fn=None,
-                  sum_fn=None) -> Callable[[OverlayState, jax.Array], OverlayState]:
+                  sum_fn=None, n_rows: int | None = None,
+                  ) -> Callable[[OverlayState, jax.Array], OverlayState]:
     """Build the per-round transition.
 
     The three hooks make the same body run single-device or per-shard inside
@@ -167,11 +170,13 @@ def make_round_fn(cfg: Config,
              delivery when sharded.
       ids_fn() -> global ids of the local rows (arange(n) by default).
       sum_fn(x) -> global scalar reduction (identity by default; psum sharded).
+    `n_rows` (local rows; defaults to cfg.n) sizes the mailbox cap -- it
+    must match init_state's n_local so the emission widths agree.
     """
     n = cfg.n
     k = cfg.max_degree
     fanout, fanin = cfg.fanout, cfg.fanin_resolved
-    cap = cfg.mailbox_cap_resolved
+    cap = cfg.mailbox_cap_for(n_rows if n_rows is not None else n)
     em, eb = cap + 2, cap
     if deliver_fn is None:
         # Emission lists are mostly empty once membership settles: compact

@@ -72,6 +72,22 @@ class SimState(NamedTuple):
     exchange_overflow: jnp.ndarray  # int32[]
 
 
+def in_flight(st) -> jnp.ndarray:
+    """int32 0/1: nonzero iff any message is still undelivered --
+    engine-agnostic (EventState or SimState; duck-typed on the mail ring so
+    this module needs no import of either engine).  An indicator, NOT a
+    count: every caller only tests emptiness, and a full count would
+    overflow int32 when summed across shards near ring occupancy
+    (event.slot_cap clamps each shard to ~2^31 entries).  THE single
+    definition of "wave still alive": the host exhaustion check
+    (backends/base.run_bounded_to_target) and every engine's device-side
+    run cond all call this, so they cannot drift."""
+    if hasattr(st, "mail_cnt"):
+        return jnp.any(st.mail_cnt > 0).astype(jnp.int32)
+    return (jnp.any(st.pending > 0) | jnp.any(st.rebroadcast)).astype(
+        jnp.int32)
+
+
 class OverlayState(NamedTuple):
     """Overlay-construction state (phase 1).  Message buffers hold the
     makeups/breakups emitted this round, delivered next round (the vectorized

@@ -27,6 +27,14 @@ from gossip_simulator_tpu.ops.select import first_true_indices
 _warned_dense_fallback = False
 
 
+def flat_addressing_fits(n: int, cap: int) -> bool:
+    """True iff the [n, cap] mailbox can use flat int32 addressing (the fast
+    sort + 1-D-scatter delivery paths; index n*cap is the trash cell).  The
+    auto mailbox cap (Config.mailbox_cap_resolved) shrinks 16 -> 8 past
+    n ~ 1.34e8 precisely to keep this true up to n ~ 2.7e8."""
+    return (n + 1) * cap < 2**31
+
+
 def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
                 cap: int):
     """Append one entry per True in `valid` into its `wslot` window slot of
@@ -106,7 +114,7 @@ def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
     """
     m = src.shape[0]
     if compact_chunk is not None and compact_chunk < m:
-        if (n + 1) * cap < 2**31:
+        if flat_addressing_fits(n, cap):
             return _deliver_compact(src, dst, valid, n, cap, compact_chunk)
         # Flat int32 addressing no longer fits: the requested compaction is
         # ignored and the full-length sort + 2-D scatter path below runs
@@ -126,7 +134,7 @@ def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
                           is_stable=True)
     rank = segment_ranks(sd)
     ok = (sd < n) & (rank < cap)
-    if (n + 1) * cap < 2**31:
+    if flat_addressing_fits(n, cap):
         flat = jnp.where(ok, sd * cap + rank, n * cap)  # in-bounds trash cell
         mbox = jnp.full((n * cap + 1,), -1, dtype=jnp.int32)
         mbox = mbox.at[flat].set(

@@ -355,10 +355,9 @@ def make_run_to_coverage_fn(cfg: Config, mesh):
                 # (event.make_run_to_coverage_fn).  Indicator, not count:
                 # a cross-shard sum of entry counts could wrap int32 near
                 # ring occupancy.
-                occupied = jnp.any(s.mail_cnt > 0).astype(jnp.int32)
                 return ((s.total_received < target_count)
                         & (s.tick < max_steps) & (s.tick < until)
-                        & (jax.lax.psum(occupied, AXIS) > 0))
+                        & (jax.lax.psum(event.in_flight(s), AXIS) > 0))
 
             def body(s):
                 return jax.lax.fori_loop(

@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic, graphs, overlay
+from gossip_simulator_tpu.models import state as state_mod
 from gossip_simulator_tpu.models.state import (OverlayState, SimState,
                                                msg64_add)
 from gossip_simulator_tpu.ops.mailbox import deliver
@@ -300,7 +301,7 @@ def make_sharded_init(cfg: Config, mesh):
 def make_sharded_overlay_round(cfg: Config, mesh):
     s = mesh.shape[AXIS]
     n_local = shard_size(cfg.n, mesh)
-    cap = cfg.mailbox_cap_resolved
+    cap = cfg.mailbox_cap_for(n_local)
     # Membership messages per node per round <= em/eb; same capacity logic as
     # the epidemic wave.
     route_cap = exchange.epidemic_cap(n_local, cap + 2, s)
@@ -327,7 +328,8 @@ def make_sharded_overlay_round(cfg: Config, mesh):
         return jax.lax.psum(x, AXIS)
 
     body = overlay.make_round_fn(cfg, deliver_fn=routed_deliver,
-                                 ids_fn=ids_fn, sum_fn=sum_fn)
+                                 ids_fn=ids_fn, sum_fn=sum_fn,
+                                 n_rows=n_local)
 
     def round_shard(st: OverlayState, base_key: jax.Array) -> OverlayState:
         # Decorrelate per-shard draws inside the round body by folding the
@@ -382,14 +384,24 @@ def make_run_to_coverage_fn(cfg: Config, mesh):
     specs = sim_state_specs()
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
+    check_in_flight = cfg.protocol != "pushpull"
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run(st: SimState, base_key: jax.Array, target_count: jax.Array,
             until: jax.Array) -> SimState:
         def run_shard(st, base_key, target_count, until):
             def cond(s):
-                return ((s.total_received < target_count)
+                live = ((s.total_received < target_count)
                         & (s.tick < max_steps) & (s.tick < until))
+                if check_in_flight:
+                    # psum of each shard's ring-occupied indicator
+                    # (replicated, so every shard agrees): exit at wave
+                    # death instead of spinning to the bounded-call budget
+                    # -- same term the sharded event engine's cond has
+                    # (event_sharded.make_run_to_coverage_fn).
+                    live = live & (jax.lax.psum(state_mod.in_flight(s),
+                                                AXIS) > 0)
+                return live
 
             def body(s):
                 return jax.lax.fori_loop(

@@ -37,10 +37,23 @@ def test_max_degree():
     dict(protocol="blorp"),
     dict(coverage_target=0.0),
     dict(n=5, fanout=5),
+    # ticks-mode delay-ring engines clamp delays to >= 1; delaylow=0 would
+    # silently reshape the distribution (ADVICE r2) -- rejected on the
+    # vectorized backends.
+    dict(delaylow=0, delayhigh=5, backend="jax"),
+    dict(delaylow=0, delayhigh=5, backend="sharded", n=4000),
 ])
 def test_validation_rejects(kw):
     with pytest.raises(ValueError):
         Config(**kw).validate()
+
+
+def test_delaylow_zero_allowed_where_faithful():
+    # Discrete-event backends handle zero-delay exactly; rounds mode never
+    # draws delays at all.
+    Config(delaylow=0, delayhigh=5, backend="native").validate()
+    Config(delaylow=0, delayhigh=5, backend="jax",
+           time_mode="rounds").validate()
 
 
 def test_parameter_dump_format():

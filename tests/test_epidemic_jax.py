@@ -139,6 +139,61 @@ def test_run_to_target_fast_path_matches_windows():
     assert fast.total_received == res.stats.total_received
 
 
+def test_ring_exhaustion_exits_device_loop():
+    """A dead wave on the ring engine must exit the device-side while_loop
+    at wave death (in-flight term in the run cond, parity with the event
+    engine), not spin empty windows until the bounded-call budget (~1024
+    ticks at this n) lets the host notice."""
+    cfg = Config(**{**BASE, "engine": "ring", "droprate": 1.0,
+                    "max_rounds": 50_000, "progress": False}).validate()
+    assert cfg.engine_resolved == "ring"
+    s = JaxStepper(cfg)
+    s.init()
+    s.seed()
+    st = s.run_to_target()
+    assert s.exhausted
+    assert st.total_received <= 1  # the seed's self-mark only
+    assert st.round <= 20  # exited at wave death, not at the call budget
+
+
+def test_ring_exhaustion_tick_matches_windowed():
+    """Die-out config (fanout 1, drop 0.3 is subcritical): the ring fast
+    path's death tick must equal the windowed loop's, since both observe
+    the empty ring at the same 10 ms cadence."""
+    import io
+
+    kw = {**BASE, "engine": "ring", "fanout": 1, "droprate": 0.3,
+          "max_rounds": 50_000, "progress": False}
+    cfg = Config(**kw).validate()
+    s = JaxStepper(cfg)
+    s.init()
+    s.seed()
+    fast = s.run_to_target()
+    assert s.exhausted
+    printer = ProgressPrinter(enabled=True, out=io.StringIO())
+    assert printer.observing
+    res = run_simulation(Config(**kw).validate(), printer=printer)
+    assert not res.converged
+    assert fast.round == res.stats.round
+    assert fast.round < cfg.max_rounds
+    assert fast.total_message == res.stats.total_message
+
+
+def test_ring_sir_exhaustion_exits_device_loop():
+    """SIR on the ring engine: in-flight includes the re-broadcast ring, so
+    a wave that is pending-empty but still scheduled to re-broadcast must
+    NOT exit early -- removal_rate=1 degenerates to SI and dies like it."""
+    cfg = Config(**{**BASE, "engine": "ring", "protocol": "sir",
+                    "removal_rate": 1.0, "fanout": 1, "droprate": 0.3,
+                    "max_rounds": 50_000, "progress": False}).validate()
+    s = JaxStepper(cfg)
+    s.init()
+    s.seed()
+    st = s.run_to_target()
+    assert s.exhausted
+    assert st.round < cfg.max_rounds
+
+
 def test_overlay_quiesces_and_degrees():
     cfg = Config(n=1200, backend="jax", seed=4, progress=False).validate()
     s = JaxStepper(cfg)

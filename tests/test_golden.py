@@ -52,6 +52,21 @@ def test_overlay_ticks_byte_exact():
     assert out == _golden("overlay_ticks.txt")
 
 
+def test_sharded_overlay_byte_exact():
+    """Multi-chip output surface on the 8-fake-device CPU mesh: replicated
+    psum'd totals printed once (single printer), per-window membership
+    counts from the sharded overlay engine, estimated rounds-mode
+    stabilization clock, and the final totals line.  Regenerate with:
+    PALLAS_AXON_POOL_IPS="" JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m gossip_simulator_tpu -n 2000 -backend sharded -graph overlay \
+    -fanout 5 -seed 9 -coverage-target 0.9 > tests/golden/sharded_overlay.txt
+    """
+    out = _run_cli("-n", "2000", "-backend", "sharded", "-graph", "overlay",
+                   "-fanout", "5", "-seed", "9", "-coverage-target", "0.9")
+    assert out == _golden("sharded_overlay.txt")
+
+
 def test_compat_reference_seconds_rendering_byte_exact():
     """Delays in the hundreds of ms push both phase summaries past 1s,
     pinning the s-unit rendering (`7.12s`, `4s`) alongside ms."""

@@ -99,6 +99,50 @@ def test_sharded_sir():
     assert res.converged
 
 
+def test_sharded_ring_exhaustion_exits_device_loop():
+    """Dead wave on the sharded RING engine: the run cond's psum'd in-flight
+    term must exit the device while_loop at wave death (parity with the
+    sharded event engine's cond), not spin to the bounded-call budget."""
+    cfg = Config(**{**BASE, "backend": "sharded", "engine": "ring",
+                    "droprate": 1.0, "max_rounds": 50_000,
+                    "progress": False}).validate()
+    assert cfg.engine_resolved == "ring"
+    s = ShardedStepper(cfg)
+    s.init()
+    s.seed()
+    st = s.run_to_target()
+    assert s.exhausted
+    assert st.total_received <= 1  # the seed's self-mark only
+    assert st.round <= 20  # exited at wave death, not at the call budget
+
+
+def test_sharded_ring_exhaustion_tick_matches_windowed():
+    """Die-out config: the sharded ring fast path's death tick must equal
+    the windowed loop's (both observe the empty ring at the 10 ms cadence)."""
+    import io
+
+    # seed=7: the wave survives ~11 windows before dying (seed 5's single
+    # fanout-1 send is dropped immediately, a degenerate death-at-tick-0
+    # where the windowed driver necessarily reports its mandatory first
+    # window instead).
+    kw = {**BASE, "backend": "sharded", "engine": "ring", "fanout": 1,
+          "droprate": 0.3, "seed": 7, "max_rounds": 50_000,
+          "progress": False}
+    cfg = Config(**kw).validate()
+    s = ShardedStepper(cfg)
+    s.init()
+    s.seed()
+    fast = s.run_to_target()
+    assert s.exhausted
+    printer = ProgressPrinter(enabled=True, out=io.StringIO())
+    assert printer.observing
+    res = run_simulation(Config(**kw).validate(), printer=printer)
+    assert not res.converged
+    assert fast.round == res.stats.round
+    assert fast.round < cfg.max_rounds
+    assert fast.total_message == res.stats.total_message
+
+
 def test_n_not_divisible_rejected():
     with pytest.raises(ValueError, match="divisible"):
         ShardedStepper(Config(n=4001, backend="sharded",
