@@ -177,18 +177,35 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
 
 
 def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
-    """Drain chunk size: auto = clamp(n/128, 128k, 512k).
+    """Drain chunk size: auto = a degree-scaled n/128 ramp with
+    r = mean_degree / 4 (the fanout-3 kout calibration; max_degree 4
+    there): clamp(n/128 * r^3, 131k, hi) where hi = 1M for r >= 1.5 else
+    512k, rounded UP to a power of two (the sort pads to one internally).
 
-    Swept empirically on v5e.  n=1e7: 64k:752, 128k:769->922 (post
-    friend_cnt removal), 156k:882, 256k:718->794, 512k:623, 1M:487
-    M node-updates/s -- op cost grows superlinearly past ~128k entries
-    (sort passes, scatter contention), favoring small chunks.  n=1e8:
-    128k:303, 256k:782, 512k:903, 1M:880 -- the n-sized flag
-    gather/scatter per chunk grows with n, so fewer/larger chunks win.
-    The n/128 ramp hits both optima."""
+    Swept empirically on v5e.  Fanout 3 kout: n=1e7: 64k:752,
+    128k:769->922 (post friend_cnt removal), 156k:882, 256k:718->794,
+    512k:623, 1M:487 M node-updates/s -- op cost grows superlinearly past
+    ~128k entries (sort passes, scatter contention), favoring small
+    chunks; n=1e8: 128k:303, 256k:782, 512k:903, 1M:880 -- the n-sized
+    flag gather/scatter per chunk grows with n, so fewer/larger chunks
+    win.  Fanout 6 kout (the 99%-coverage north-star config, ~5x the
+    entries per window; swept 2026-07-31): n=1e7: 131k:7.08s, 262k:6.53,
+    512k:6.18, 1M:6.27 time-to-99%; n=1e8: 512k:57.8, 1M:49.5, 2M:55.6 --
+    higher message volume pushes the optimum up roughly with degree^3
+    over this range.  The scaled ramp lands within ~3% of all six
+    measured optima; the cap keeps low-degree configs (incl. the proven
+    1e8 fanout-3 headline at 512k) exactly where their sweeps put them."""
     n = n_local if n_local is not None else cfg.n
-    want = cfg.event_chunk if cfg.event_chunk > 0 else \
-        min(524_288, max(131_072, n // 128))
+    if cfg.event_chunk > 0:
+        want = cfg.event_chunk
+    else:
+        r = max(1.0, cfg.mean_degree / 4.0)
+        hi = 1_048_576 if r >= 1.5 else 524_288
+        want = min(hi, max(131_072, int(n // 128 * r ** 3)))
+        # Round up to a power of two: the sort pads to one internally, so
+        # a 918k chunk costs a 1M sort but drains only 918k entries
+        # (measured 55.6s vs 49.5s at the 1e8 fanout-6 config).
+        want = 1 << (want - 1).bit_length()
     return min(slot_cap(cfg, n_local), max(256, want))
 
 
@@ -601,8 +618,6 @@ def make_run_to_coverage_fn(cfg: Config):
         return jax.lax.while_loop(cond, body, st)
 
     return run_fn
-
-
 
 
 def removed_count(st) -> jnp.ndarray:

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Drain-chunk sweep for the fanout-6 (99%-coverage, north-star) configs.
+
+The original sweep (drain_chunk docstring) calibrated the auto chunk on
+fanout-3 message volume (~2.4 messages/node); fanout 6 carries ~5x the
+entries per window, so the auto size n/128 yields 3-8x more chunks per
+window.  This measures whether fewer, larger chunks win at that volume.
+
+Usage: python scripts/chunk_sweep_f6.py [--n 10000000] [--chunks 0,262144,...]
+(0 = the auto size.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_simulator_tpu.utils import jaxsetup
+
+jaxsetup.setup()
+
+import jax  # noqa: E402
+
+from gossip_simulator_tpu.backends.jax_backend import JaxStepper  # noqa: E402
+from gossip_simulator_tpu.config import Config  # noqa: E402
+from gossip_simulator_tpu.models import event  # noqa: E402
+
+
+def run_once(cfg: Config) -> dict:
+    s = JaxStepper(cfg)
+    s.init()
+    jax.block_until_ready(s.state.friends)
+    s.seed()
+    s.run_to_target()  # compile + warm
+    s.reset_state()
+    s.seed()
+    t0 = time.perf_counter()
+    st = s.run_to_target()
+    run_s = time.perf_counter() - t0
+    return {"run_s": round(run_s, 3), "ticks": st.round,
+            "coverage": round(st.coverage, 5),
+            "total_message": st.total_message}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000_000)
+    ap.add_argument("--fanout", type=int, default=6)
+    ap.add_argument("--coverage-target", type=float, default=0.99)
+    ap.add_argument("--chunks", default="0,262144,524288,1048576")
+    args = ap.parse_args()
+    for c in (int(x) for x in args.chunks.split(",")):
+        cfg = Config(n=args.n, fanout=args.fanout, graph="kout",
+                     backend="jax", seed=0, crashrate=0.001,
+                     coverage_target=args.coverage_target, max_rounds=3000,
+                     event_chunk=c, pallas=True, progress=False).validate()
+        eff = event.drain_chunk(cfg)
+        r = run_once(cfg)
+        print(f"chunk={c or 'auto':>8} (eff {eff:>8,}): {r}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

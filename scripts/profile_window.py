@@ -71,18 +71,22 @@ def parse_trace(trace_dir: str,
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000_000)
+    ap.add_argument("--fanout", type=int, default=3)
+    ap.add_argument("--coverage-target", type=float, default=0.90)
+    ap.add_argument("--warmup", type=int, default=8)
     ap.add_argument("--windows", type=int, default=20)
     ap.add_argument("--out", default="/tmp/gossip_profile")
     args = ap.parse_args()
     on_tpu = jax.default_backend() == "tpu"
-    cfg = Config(n=args.n, fanout=3, graph="kout", backend="jax", seed=0,
-                 crashrate=0.001, coverage_target=0.90, max_rounds=3000,
+    cfg = Config(n=args.n, fanout=args.fanout, graph="kout", backend="jax",
+                 seed=0, crashrate=0.001,
+                 coverage_target=args.coverage_target, max_rounds=3000,
                  pallas=on_tpu, progress=False).validate()
     s = JaxStepper(cfg)
     s.init()
     s.seed()
     # Steady state: run past the early near-empty windows.
-    for _ in range(8):
+    for _ in range(args.warmup):
         s.gossip_window()
     jax.block_until_ready(s.state.flags)
     t0 = time.perf_counter()
