@@ -425,6 +425,55 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     return flags, dm, dr, dc, ids_s, toff_s, senders
 
 
+def sender_compaction_cap(cfg: Config, ccap: int) -> int:
+    """Sender-compaction batch width (0 = dense append), shared by the
+    single-device and sharded window steps so the two engines cannot
+    drift.
+
+    At mean degree d only ~1/(0.9 d) of drained entries are NEW senders,
+    yet the dense append pays friends-gather + mail-scatter at full
+    ccap x k width -- profiled at 65% of the fanout-6 window (mail
+    scatter 33% incl. its internal 3M-lane sort, friends gather 26%),
+    both element-bound at these widths.  Compacting senders via ONE
+    cumsum-rank + ONE packed scatter (not the 5-op first_true_indices
+    selection that measured 6-10% slower at fanout 3 in r2) shrinks
+    those widths 2-4x; the reservation order -- hence the mail layout,
+    hence every position-keyed crash draw -- is bit-identical on the
+    single-device path (ranks ascend in chunk order, batches continue
+    sequentially), verified against the exact pre-compaction totals at
+    1e7/1e8 fanout 3 and 6.  Measured 2026-07-31 (warm, v5e): 1e7
+    fanout 6: 6.29 -> 3.61s; 1e8 fanout 6: 49.5 -> 37.3s; 1e7 fanout 3
+    headline: 2.61 -> 2.36s (1.19B node-updates/s).  The batch width
+    tracks the typical sender fraction (ccap/2 covers the ~38% of
+    actual degree 3; ccap/4 the ~20% of degree >= 5; the >= 3.0 bound
+    admits erdos lambda=3, whose sender fraction matches kout fanout 3
+    -- kout mean_degree is the column width fanout+1); actual degree
+    <= 2 keeps the dense path -- nearly every entry is a new sender
+    there, so batching would only add ops."""
+    if cfg.mean_degree >= 5.0:
+        return ccap // 4
+    if cfg.mean_degree >= 3.0:
+        return ccap // 2
+    return 0
+
+
+def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb):
+    """Extract compacted sender batch `jb`: rows with rank in
+    [jb*scap, (jb+1)*scap) land at rank-relative positions via one packed
+    scatter (in-bounds trash cell at scap, sliced off).  Returns
+    (sids, stoff, svalid) of static width scap."""
+    lo = jb * scap
+    pos = srank - lo
+    sel = senders & (pos >= 0) & (pos < scap)
+    idx = jnp.where(sel, pos, scap)
+    buf = jnp.zeros((scap + 1,), I32).at[idx].set(
+        jnp.where(sel, spacked, 0))[:scap]
+    sids = buf // b
+    stoff = buf - sids * b
+    svalid = jnp.arange(scap, dtype=I32) < (scnt - lo)
+    return sids, stoff, svalid
+
+
 def make_window_step_fn(cfg: Config, n_local: int | None = None):
     """One B-tick window transition: drain this window's packed list in
     chunks (drain_chunk_core), and emit the newly infected nodes' broadcasts
@@ -436,6 +485,7 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
     sir = cfg.protocol == "sir"
     removal_p = epidemic.p_eff(cfg, cfg.removal_rate) if sir else 0.0
+    scap = sender_compaction_cap(cfg, ccap)
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.flags.shape[0]
@@ -457,6 +507,46 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                 drain_chunk_core(crash_p, b, n, flags, packed, evalid,
                                  entry_pos, ckey, sir=sir)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
+            if scap:
+                # Compact senders to <=scap-row batches (sender_batch),
+                # then append at reduced width.  Same (tick, row)-keyed
+                # RNG streams, same reservation order => bit-identical
+                # mail layout and totals (canary-checked).
+                srank = jnp.cumsum(senders.astype(I32)) - 1
+                scnt = senders.sum(dtype=I32)
+                spacked = ids_s * b + toff_s
+                nb = (scnt + scap - 1) // scap
+
+                def abody(jb, acarry):
+                    aflags, amail_ids, amail_cnt, adropped = acarry
+                    sids, stoff, svalid = sender_batch(
+                        senders, srank, scnt, spacked, b, scap, jb)
+                    stick2 = w * b + stoff
+                    strig = None
+                    if sir:
+                        # Removal draw per sender at its send tick (the
+                        # ring engine's removal-after-send, tick_core);
+                        # removed senders still broadcast this once but
+                        # schedule no next trigger.
+                        rows = jnp.where(svalid, sids, n)
+                        rk = _sender_keys(base_key, _rng.OP_REMOVE,
+                                          stick2, rows)
+                        rem = (jax.vmap(lambda kk: jax.random.bernoulli(
+                            kk, removal_p))(rk) & svalid) \
+                            if removal_p > 0.0 \
+                            else jnp.zeros((scap,), bool)
+                        aflags = aflags.at[jnp.where(rem, sids, n)].add(
+                            REMOVED, mode="drop")
+                        strig = svalid & ~rem
+                    amail_ids, amail_cnt, adropped = append_messages(
+                        cfg, amail_ids, amail_cnt, adropped, sids, svalid,
+                        stick2, st.friends, st.friend_cnt, base_key,
+                        strig=strig)
+                    return (aflags, amail_ids, amail_cnt, adropped)
+
+                flags, mail_ids, mail_cnt, dropped = jax.lax.fori_loop(
+                    0, nb, abody, (flags, mail_ids, mail_cnt, dropped))
+                return (flags, mail_ids, mail_cnt, dm, dr, dc, dropped)
             sticks = w * b + toff_s
             strig = None
             if sir:
@@ -471,16 +561,15 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                 flags = flags.at[jnp.where(rem, ids_s, n)].add(
                     REMOVED, mode="drop")
                 strig = senders & ~rem
-            # Senders broadcast at their delivery tick (simulator.go:120-122).
-            # No compaction: the mask feeds append_messages directly --
-            # senders appear in the same ascending-id order a nonzero()
-            # compaction would produce, so reservation ranks and the mail
-            # layout are bit-identical, minus the nonzero + two gathers.
+            # Dense append (low-degree configs): the mask feeds
+            # append_messages directly -- senders appear in the same
+            # ascending-id order a nonzero() compaction would produce, so
+            # reservation ranks and the mail layout are bit-identical.
             # (Measured 2026-07-30: compacting senders to ccap/2 via
             # first_true_indices before the append was bit-identical but
-            # ~6-10% SLOWER at n=1e7/1e8 -- per-op overhead dominates on
-            # this platform, so halving op width saves less than the ~5
-            # compaction ops cost.  Don't re-try without re-measuring.)
+            # ~6-10% SLOWER at n=1e7/1e8 fanout 3 -- the 5-op selection
+            # cost more than the 2.4x width saving; the 2-op rank-scatter
+            # compaction above pays only at higher degree.)
             mail_ids, mail_cnt, dropped = append_messages(
                 cfg, mail_ids, mail_cnt, dropped,
                 jnp.where(senders, ids_s, 0), senders, sticks,

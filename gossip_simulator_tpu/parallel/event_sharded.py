@@ -150,6 +150,8 @@ def make_sharded_event_step(cfg: Config, mesh):
         raise ValueError(
             f"SIR trigger packing overflow: (2*n_local+3) ({2*n_local+3}) "
             f"* B ({b}) must stay below 2^31; use more shards")
+    # Same degree-gated sender-compaction width as the single-device step.
+    scap = event.sender_compaction_cap(cfg, ccap)
 
     def step_shard(st: EventState, base_key: jax.Array) -> EventState:
         shard = jax.lax.axis_index(AXIS)
@@ -161,30 +163,21 @@ def make_sharded_event_step(cfg: Config, mesh):
         ckey = _rng.tick_key(skey, w, _rng.OP_CRASH)
         kwidth = st.friends.shape[1]
         rcap = min(exchange.epidemic_cap(n_local, kwidth, s), ccap * kwidth)
+        # Compacted batches carry at most scap senders; scap * kwidth is
+        # the ZERO-LOSS per-pair buffer (a batch cannot emit more edges
+        # than that), matching the dense path's effective lossless
+        # ccap * kwidth -- an epidemic_cap-style mean*safety bound would
+        # drop skewed batches at n_shards > 4.
+        rcap_c = scap * kwidth if scap else 0
         cap = (st.mail_ids.shape[0] - ccap) // dw
 
-        def body(j, carry):
-            (flags, mail, cnt, dm, dr, dc, dropped, xovf) = carry
-            off0 = j * ccap
-            entry_pos = off0 + jnp.arange(ccap, dtype=I32)
-            evalid = entry_pos < m
-            packed = jax.lax.dynamic_slice(mail, (slot * cap + off0,),
-                                           (ccap,))
-            flags, cdm, cdr, cdc, ids_s, toff_s, senders = \
-                event.drain_chunk_core(crash_p, b, n_local, flags,
-                                       packed, evalid, entry_pos,
-                                       ckey, sir=sir)
-            dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
-            # Senders (newly infected, plus firing SIR triggers) broadcast
-            # at their delivery tick; delay/drop keys are shard-folded +
-            # local-row-keyed, the same scheme the sharded ring engine
-            # uses.  No compaction (see the single-device step): the mask
-            # feeds the emission directly, with identical reservation
-            # order.
-            svalid = senders
-            sids = ids_s
+        def emit(flags, mail, cnt, dropped, xovf, sids, svalid, sticks,
+                 width, ecap):
+            """Route one batch of senders' broadcasts (delay/drop draws,
+            SIR removal + local triggers, all_to_all + ring append) at a
+            static `width`.  Keys are shard-folded + (tick, local-row)
+            keyed, so the draws do not depend on the batch width."""
             rows = jnp.where(svalid, sids, n_local)
-            sticks = w * b + toff_s
             sidx = jnp.where(svalid, sids, 0)
             sf = st.friends.at[sidx].get()
             # No friend_cnt gather: rows are prefix-compact, (sf >= 0) is
@@ -195,9 +188,9 @@ def make_sharded_event_step(cfg: Config, mesh):
                 lambda kk: jax.random.randint(
                     kk, (), cfg.delaylow, cfg.delayhigh, dtype=I32))(dk), 1)
             if drop_p <= 0.0:
-                drop = jnp.zeros((ccap, kwidth), bool)
+                drop = jnp.zeros((width, kwidth), bool)
             elif drop_p >= 1.0:
-                drop = jnp.ones((ccap, kwidth), bool)
+                drop = jnp.ones((width, kwidth), bool)
             else:
                 drop = jax.vmap(
                     lambda kk: jax.random.bernoulli(kk, drop_p,
@@ -205,6 +198,7 @@ def make_sharded_event_step(cfg: Config, mesh):
             arrive = sticks + delay
             wslot2 = (arrive // b) % dw
             off2 = arrive % b
+            rem = None
             if sir:
                 # Removal draw per sender at its send tick (same ordering
                 # as the single-device step); surviving senders schedule
@@ -220,13 +214,58 @@ def make_sharded_event_step(cfg: Config, mesh):
             dstg = jnp.where(edge, sf, 0).reshape(-1)
             mail, cnt, dropped, xovf = _route_and_append(
                 cfg, s, n_local, mail, cnt, dropped, xovf, dstg,
-                jnp.broadcast_to(wslot2[:, None], (ccap, kwidth)).reshape(-1),
-                jnp.broadcast_to(off2[:, None], (ccap, kwidth)).reshape(-1),
-                edge.reshape(-1), rcap)
+                jnp.broadcast_to(wslot2[:, None],
+                                 (width, kwidth)).reshape(-1),
+                jnp.broadcast_to(off2[:, None],
+                                 (width, kwidth)).reshape(-1),
+                edge.reshape(-1), ecap)
             if sir:
                 mail, cnt, dropped = _append_local_triggers(
                     cfg, n_local, mail, cnt, dropped, rows, svalid & ~rem,
                     wslot2, off2)
+            return flags, mail, cnt, dropped, xovf
+
+        def body(j, carry):
+            (flags, mail, cnt, dm, dr, dc, dropped, xovf) = carry
+            off0 = j * ccap
+            entry_pos = off0 + jnp.arange(ccap, dtype=I32)
+            evalid = entry_pos < m
+            packed = jax.lax.dynamic_slice(mail, (slot * cap + off0,),
+                                           (ccap,))
+            flags, cdm, cdr, cdc, ids_s, toff_s, senders = \
+                event.drain_chunk_core(crash_p, b, n_local, flags,
+                                       packed, evalid, entry_pos,
+                                       ckey, sir=sir)
+            dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
+            if scap:
+                # Sender compaction (see the single-device step's
+                # rationale -- the emission's gathers/route inputs are
+                # element-bound, and only ~1/(0.9 deg) of entries are
+                # senders).  The batch count is pmax-agreed so every
+                # shard runs the same number of all_to_alls; receiving
+                # slots see arrivals in batch order, a (deterministic)
+                # reshuffle of the dense path's per-chunk order, so
+                # per-shard trajectories shift within the usual
+                # sharded-vs-single distributional envelope.
+                srank = jnp.cumsum(senders.astype(I32)) - 1
+                scnt = senders.sum(dtype=I32)
+                spacked = ids_s * b + toff_s
+                nb = (jax.lax.pmax(scnt, AXIS) + scap - 1) // scap
+
+                def abody(jb, acarry):
+                    aflags, amail, acnt, adropped, axovf = acarry
+                    bids, btoff, bvalid = event.sender_batch(
+                        senders, srank, scnt, spacked, b, scap, jb)
+                    return emit(aflags, amail, acnt, adropped, axovf,
+                                bids, bvalid, w * b + btoff, scap,
+                                rcap_c)
+
+                flags, mail, cnt, dropped, xovf = jax.lax.fori_loop(
+                    0, nb, abody, (flags, mail, cnt, dropped, xovf))
+            else:
+                flags, mail, cnt, dropped, xovf = emit(
+                    flags, mail, cnt, dropped, xovf, ids_s, senders,
+                    w * b + toff_s, ccap, rcap)
             return (flags, mail, cnt, dm, dr, dc, dropped, xovf)
 
         z = jnp.zeros((), I32)
