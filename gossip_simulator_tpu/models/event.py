@@ -441,7 +441,14 @@ def sender_compaction_cap(cfg: Config, ccap: int) -> int:
     hence every position-keyed crash draw -- is bit-identical on the
     single-device path (ranks ascend in chunk order, batches continue
     sequentially), verified against the exact pre-compaction totals at
-    1e7/1e8 fanout 3 and 6.  Measured 2026-07-31 (warm, v5e): 1e7
+    1e7/1e8 fanout 3 and 6, and pinned by a dense-vs-compacted A/B test.
+    CAVEAT: the identity holds while mail_dropped stays 0 (auto slot_cap
+    budgets for exactly that).  Under slot-cap overflow the paths
+    diverge at the margin: an overflowed sender in an early batch
+    reserves nothing, so later batches start at lower offsets and may
+    fit entries the dense single-call append -- whose per-chunk prefix
+    counts overflowed senders' reservations -- would also have
+    overflowed.  Measured 2026-07-31 (warm, v5e): 1e7
     fanout 6: 6.29 -> 3.61s; 1e8 fanout 6: 49.5 -> 37.3s; 1e7 fanout 3
     headline: 2.61 -> 2.36s (1.19B node-updates/s).  The batch width
     tracks the typical sender fraction (ccap/2 covers the ~38% of

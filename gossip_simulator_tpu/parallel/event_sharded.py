@@ -3,10 +3,14 @@
 Same design as the single-device event engine (models/event.py) with the
 node axis split over the 1-D "nodes" mesh: every shard drains its own packed
 mail ring locally, and the emission step routes each message to its
-destination's owner shard with one `lax.all_to_all` per drain chunk
-(parallel/exchange.py) -- the ICI replacement for the reference's shared
-`GlobalView[id].ch <- msg` sends (simulator.go:145).  Chunk counts are
-pmax-agreed so every shard executes the same number of collectives.
+destination's owner shard with `lax.all_to_all` (parallel/exchange.py) --
+the ICI replacement for the reference's shared `GlobalView[id].ch <- msg`
+sends (simulator.go:145).  Collective counts are pmax-agreed at BOTH
+levels so every shard executes the same number: drain chunks per window,
+and -- when sender compaction engages (event.sender_compaction_cap) --
+ceil(pmax(senders)/scap) emission batches per chunk, each routing one
+all_to_all with a zero-loss scap*kwidth per-pair buffer (degree <= 2
+configs emit one full-width all_to_all per chunk as before).
 
 Wire format: one int32 per message, `dst_local * (dw*B) + wslot * B + off`
 (destination's local row, arrival window slot, tick offset).  Requires

@@ -406,3 +406,104 @@ def test_event_checkpoint_repacks_across_chunk_geometry():
     # crash entry_pos stream -- crashrate is 0 here) differs.
     assert a.total_received == b.total_received
     assert a.total_message == b.total_message
+
+def test_sender_batch_extraction():
+    """sender_batch: rank-ordered extraction of compacted sender batches
+    (empty mask, all-senders multi-batch, and a mid-density case), with
+    svalid marking exactly the live rows of each batch."""
+    import jax.numpy as jnp
+
+    from gossip_simulator_tpu.models.event import sender_batch
+
+    b = 10
+    ids = jnp.arange(12, dtype=np.int32)
+    toff = (ids * 3) % b
+    packed = ids * b + toff
+
+    def batches(mask, scap):
+        mask = jnp.asarray(mask)
+        srank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        scnt = mask.sum(dtype=jnp.int32)
+        out = []
+        nb = max(1, -(-int(scnt) // scap))
+        for jb in range(nb):
+            sids, stoff, svalid = sender_batch(mask, srank, scnt, packed,
+                                               b, scap, jb)
+            out.append((np.asarray(sids), np.asarray(stoff),
+                        np.asarray(svalid)))
+        return out
+
+    # Empty mask: one batch, nothing valid.
+    (sids, stoff, svalid), = batches([False] * 12, 4)
+    assert not svalid.any()
+
+    # All senders, scap 5 -> 3 batches covering 12 rows in order.
+    got_ids, got_toff = [], []
+    for sids, stoff, svalid in batches([True] * 12, 5):
+        got_ids += sids[svalid].tolist()
+        got_toff += stoff[svalid].tolist()
+    assert got_ids == list(range(12))
+    assert got_toff == [int(x) for x in np.asarray(toff)]
+
+    # Scattered senders keep chunk order.
+    mask = [i % 3 == 1 for i in range(12)]
+    (sids, stoff, svalid), = batches(mask, 8)
+    assert sids[svalid].tolist() == [1, 4, 7, 10]
+
+
+def test_sender_compaction_cap_gates():
+    """Compaction widths by degree class: dense for actual degree <= 2,
+    half-width for the fanout-3 class, quarter-width at degree >= 5;
+    erdos lambda ranks by its true mean degree."""
+    from gossip_simulator_tpu.models.event import sender_compaction_cap
+
+    def cap(**kw):
+        cfg = Config(**{**BASE, **kw}).validate()
+        return sender_compaction_cap(cfg, 1024)
+
+    assert cap(fanout=6) == 256                      # kout deg 6 -> //4
+    assert cap(fanout=3) == 512                      # kout mean_degree 4 -> //2
+    assert cap(fanout=2, fanin=2) == 0               # width 2 -> dense
+    assert cap(graph="erdos", fanout=3) == 512       # lambda 3 -> //2
+    assert cap(graph="erdos", fanout=8) == 256       # lambda 8 -> //4
+
+
+def test_compacted_append_bit_identical_to_dense(monkeypatch):
+    """The central compaction invariant: with zero slot-cap overflow the
+    compacted append produces the SAME mail layout, flags and totals as
+    the dense path (reservation ranks ascend in chunk order; RNG draws
+    are (tick, row)-keyed).  Guards future edits to sender_batch /
+    abody ordering that CPU tests would otherwise miss (the TPU canary
+    totals are not run in CI).  The identity intentionally excludes the
+    slot-cap-overflow margin (see sender_compaction_cap's caveat)."""
+    from gossip_simulator_tpu.models import event as event_mod
+
+    def run(dense):
+        if dense:
+            monkeypatch.setattr(event_mod, "sender_compaction_cap",
+                                lambda cfg, ccap: 0)
+        else:
+            monkeypatch.undo()
+        cfg = Config(**{**BASE, "n": 400, "protocol": "sir",
+                        "removal_rate": 0.3, "crashrate": 0.02,
+                        "engine": "event", "seed": 3,
+                        "max_rounds": 120}).validate()
+        assert event_mod.sender_compaction_cap(
+            cfg, 1024) == (0 if dense else 256)
+        s = JaxStepper(cfg)
+        s.init()
+        s.seed()
+        for _ in range(10):
+            s.gossip_window()
+        return s.state, s.stats()
+
+    st_c, stats_c = run(dense=False)
+    st_d, stats_d = run(dense=True)
+    assert stats_c == stats_d
+    assert stats_c.mailbox_dropped == 0  # the regime the identity covers
+    np.testing.assert_array_equal(np.asarray(st_c.flags),
+                                  np.asarray(st_d.flags))
+    np.testing.assert_array_equal(np.asarray(st_c.mail_ids),
+                                  np.asarray(st_d.mail_ids))
+    np.testing.assert_array_equal(np.asarray(st_c.mail_cnt),
+                                  np.asarray(st_d.mail_cnt))
