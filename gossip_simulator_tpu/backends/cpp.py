@@ -16,7 +16,7 @@ import subprocess
 import warnings
 
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
-from gossip_simulator_tpu.config import Config
+
 from gossip_simulator_tpu.utils.metrics import Stats
 
 _DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native_cpp")

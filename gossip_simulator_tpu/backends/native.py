@@ -40,7 +40,7 @@ from typing import List
 import numpy as np
 
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
-from gossip_simulator_tpu.config import Config
+
 from gossip_simulator_tpu.utils.metrics import Stats
 
 # Event kinds.

@@ -5,7 +5,6 @@ mailbox delivery compaction (ops/mailbox.py)."""
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 I32 = jnp.int32

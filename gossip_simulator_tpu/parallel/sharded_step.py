@@ -20,7 +20,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic, graphs, overlay

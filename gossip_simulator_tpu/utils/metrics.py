@@ -20,7 +20,7 @@ import dataclasses
 import json
 import sys
 import time
-from typing import Callable, Optional
+from typing import Optional
 
 
 @dataclasses.dataclass

@@ -1,7 +1,6 @@
 """C++ event-driven backend: build, run, and cross-check against the Python
 oracle distributionally (same algorithm, independent implementations/RNGs)."""
 
-import math
 import shutil
 
 import pytest

@@ -5,7 +5,6 @@ configs are shared across tests so compiled executables are reused."""
 import math
 
 import numpy as np
-import pytest
 
 from gossip_simulator_tpu.backends.jax_backend import JaxStepper
 from gossip_simulator_tpu.config import Config

@@ -30,7 +30,7 @@ BASE = dict(n=4000, graph="kout", fanout=6, crashrate=0.0, seed=5)
 
 
 def test_route_one_roundtrip():
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     mesh = node_mesh(8)
 
