@@ -67,6 +67,22 @@ def test_sharded_overlay_byte_exact():
     assert out == _golden("sharded_overlay.txt")
 
 
+def test_ring_engine_byte_exact():
+    """Ring-engine CLI surface (the O(n)-per-tick delay-ring path, kept as
+    the reference implementation the event engine is bit-checked against):
+    static kout graph, per-window coverage lines, final totals.
+    Regenerate with:
+    PALLAS_AXON_POOL_IPS="" JAX_PLATFORMS=cpu \
+    python -m gossip_simulator_tpu -n 1500 -backend jax -graph kout \
+    -engine ring -fanout 6 -seed 4 -coverage-target 0.9 \
+    > tests/golden/ring_engine.txt
+    """
+    out = _run_cli("-n", "1500", "-backend", "jax", "-graph", "kout",
+                   "-engine", "ring", "-fanout", "6", "-seed", "4",
+                   "-coverage-target", "0.9")
+    assert out == _golden("ring_engine.txt")
+
+
 def test_compat_reference_seconds_rendering_byte_exact():
     """Delays in the hundreds of ms push both phase summaries past 1s,
     pinning the s-unit rendering (`7.12s`, `4s`) alongside ms."""
