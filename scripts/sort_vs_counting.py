@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Microbenchmark: the ticks-overlay drain pre-sort vs a counting sort.
+
+The pre-sort (overlay_ticks.make_step_fn) stable-sorts (toff, dst, pay) by
+toff over the full static slot cap; toff has only b+1 distinct values, so a
+counting sort -- one-hot rank + per-bucket exclusive prefix + one
+permutation scatter per carried array -- produces the IDENTICAL stable
+permutation (asserted here) at bandwidth cost instead of log^2 sort passes.
+README roadmap records the shipping gate: must win at the 1M/10M overlay
+cap widths before replacing the measured ticks-mode rows.
+
+Usage: python scripts/sort_vs_counting.py [--cap 2500000] [--b 10]
+       [--occupancy 0.3] [--reps 10]
+
+The parity assertion runs on whatever device is live (it prints which):
+on the TPU that doubles as a miscompile canary for the permutation
+scatter; for a pure-CPU correctness run use the same forced-CPU recipe as
+tests/conftest.py -- `JAX_PLATFORMS=cpu` ALONE IS A NO-OP on this image:
+    PALLAS_AXON_POOL_IPS="" JAX_PLATFORMS=cpu \
+        python scripts/sort_vs_counting.py
+Timing is only meaningful on the TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_simulator_tpu.utils import jaxsetup
+
+jaxsetup.setup()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+I32 = jnp.int32
+
+
+def sort_form(toff_key, dst, pay):
+    k, d, p = jax.lax.sort((toff_key, dst, pay), num_keys=1, is_stable=True)
+    return k, d, p
+
+
+def counting_form(toff_key, dst, pay, b: int):
+    """Stable counting sort by toff_key in [0, b] (b+1 buckets; the
+    invalid-entry bucket b sorts last, like the sort form's key b)."""
+    cap = toff_key.shape[0]
+    oh = (toff_key[:, None] == jnp.arange(b + 1, dtype=I32)[None, :])
+    ohi = oh.astype(I32)
+    cnt = jnp.cumsum(ohi, axis=0)
+    within = cnt - 1  # rank within bucket, at the one-hot column
+    sizes = cnt[-1]  # last cumsum row IS the bucket sizes (no second pass)
+    base = jnp.concatenate([jnp.zeros((1,), I32), jnp.cumsum(sizes)[:-1]])
+    pos = ((within + base[None, :]) * ohi).sum(axis=1)  # target position
+    # pos is a permutation of [0, cap): permutation scatters, no trash cell.
+    out_k = jnp.zeros((cap,), I32).at[pos].set(toff_key)
+    out_d = jnp.zeros((cap,), I32).at[pos].set(dst)
+    out_p = jnp.zeros((cap,), I32).at[pos].set(pay)
+    return out_k, out_d, out_p
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cap", type=int, default=2_500_000)
+    ap.add_argument("--b", type=int, default=10)
+    ap.add_argument("--occupancy", type=float, default=0.3)
+    ap.add_argument("--reps", type=int, default=10)
+    args = ap.parse_args()
+    cap, b = args.cap, args.b
+    rng = np.random.default_rng(0)
+    m = int(cap * args.occupancy)
+    toff = np.full((cap,), b, np.int32)
+    toff[:m] = rng.integers(0, b, m)
+    dst = rng.integers(0, 1_000_000, cap).astype(np.int32)
+    pay = rng.integers(0, 2**30, cap).astype(np.int32)
+    toff_j, dst_j, pay_j = (jnp.asarray(x) for x in (toff, dst, pay))
+
+    f_sort = jax.jit(sort_form)
+    f_count = jax.jit(lambda k, d, p: counting_form(k, d, p, b))
+    a = f_sort(toff_j, dst_j, pay_j)
+    c = f_count(toff_j, dst_j, pay_j)
+    for x, y, name in zip(a, c, ("key", "dst", "pay")):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"{name} mismatch")
+    print(f"identical stable permutation at cap={cap:,} b={b} "
+          f"occupancy={args.occupancy} on {jax.devices()[0].device_kind}")
+
+    def timeit(f):
+        jax.block_until_ready(f(toff_j, dst_j, pay_j))
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            out = f(toff_j, dst_j, pay_j)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.reps
+
+    ts, tc = timeit(f_sort), timeit(f_count)
+    print(f"lax.sort: {ts*1e3:.2f} ms   counting: {tc*1e3:.2f} ms   "
+          f"ratio {ts/max(tc,1e-9):.2f}x  "
+          f"({jax.devices()[0].device_kind})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
