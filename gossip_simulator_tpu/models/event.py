@@ -464,12 +464,46 @@ def sender_compaction_cap(cfg: Config, ccap: int) -> int:
     return 0
 
 
-def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb):
+def narrow_tail_cap(scap: int) -> int:
+    """Width of the narrow TAIL batches (0 = no narrow path).
+
+    The append's per-batch cost has two regimes: the mail scatter and
+    friends gather are element-bound at full scap width (profiled 6.3 +
+    2.6 ms at scap=262k, fanout 6, v5e) but drop toward the ~1-2 ms
+    per-op floor at ~1/8 width.  Near the coverage target most chunks
+    produce only a few thousand NEW senders, yet each paid one
+    full-width batch -- at the 1e7 fanout-6 endgame that was 27
+    batches/window for near-empty sender sets (~45% of the window).
+    Remainders <= 2*narrow widths run as 1-2 narrow batches instead;
+    larger remainders keep the full-width batch (3+ narrow trips would
+    cost more than the one element-bound batch they replace).
+    Bit-identicality: reservation layout depends only on the sender
+    ORDER (per-slot starts ride mail_cnt across batches) and every draw
+    is (tick, row)-keyed, so batch-boundary placement cannot change the
+    trajectory in the zero-overflow regime (same envelope as
+    sender_compaction_cap's caveat; pinned by the narrow-tail A/B
+    test)."""
+    if scap <= 0:
+        return 0
+    # Strictly scap//8 (no floor-clamp): a clamped width in [scap/2, scap)
+    # would make `tail` always true and split every remainder into two
+    # near-half-width batches -- same elements, double the op floor.  Below
+    # scap=8192 the batches are op-floor-bound at EITHER width, so the
+    # narrow path is disabled rather than widened.
+    ns = scap // 8
+    return ns if ns >= 1024 else 0
+
+
+def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb,
+                 lo=None):
     """Extract compacted sender batch `jb`: rows with rank in
-    [jb*scap, (jb+1)*scap) land at rank-relative positions via one packed
-    scatter (in-bounds trash cell at scap, sliced off).  Returns
-    (sids, stoff, svalid) of static width scap."""
-    lo = jb * scap
+    [lo, lo+scap) land at rank-relative positions via one packed
+    scatter (in-bounds trash cell at scap, sliced off).  `lo` defaults
+    to jb*scap (uniform batches); the narrow-tail path passes the
+    absolute start rank.  Returns (sids, stoff, svalid) of static width
+    scap."""
+    if lo is None:
+        lo = jb * scap
     pos = srank - lo
     sel = senders & (pos >= 0) & (pos < scap)
     idx = jnp.where(sel, pos, scap)
@@ -522,37 +556,64 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                 srank = jnp.cumsum(senders.astype(I32)) - 1
                 scnt = senders.sum(dtype=I32)
                 spacked = ids_s * b + toff_s
-                nb = (scnt + scap - 1) // scap
 
-                def abody(jb, acarry):
-                    aflags, amail_ids, amail_cnt, adropped = acarry
-                    sids, stoff, svalid = sender_batch(
-                        senders, srank, scnt, spacked, b, scap, jb)
-                    stick2 = w * b + stoff
-                    strig = None
-                    if sir:
-                        # Removal draw per sender at its send tick (the
-                        # ring engine's removal-after-send, tick_core);
-                        # removed senders still broadcast this once but
-                        # schedule no next trigger.
-                        rows = jnp.where(svalid, sids, n)
-                        rk = _sender_keys(base_key, _rng.OP_REMOVE,
-                                          stick2, rows)
-                        rem = (jax.vmap(lambda kk: jax.random.bernoulli(
-                            kk, removal_p))(rk) & svalid) \
-                            if removal_p > 0.0 \
-                            else jnp.zeros((scap,), bool)
-                        aflags = aflags.at[jnp.where(rem, sids, n)].add(
-                            REMOVED, mode="drop")
-                        strig = svalid & ~rem
-                    amail_ids, amail_cnt, adropped = append_messages(
-                        cfg, amail_ids, amail_cnt, adropped, sids, svalid,
-                        stick2, st.friends, st.friend_cnt, base_key,
-                        strig=strig)
-                    return (aflags, amail_ids, amail_cnt, adropped)
+                def make_abody(width, lo_of):
+                    def abody(jb, acarry):
+                        aflags, amail_ids, amail_cnt, adropped = acarry
+                        sids, stoff, svalid = sender_batch(
+                            senders, srank, scnt, spacked, b, width, jb,
+                            lo=lo_of(jb))
+                        stick2 = w * b + stoff
+                        strig = None
+                        if sir:
+                            # Removal draw per sender at its send tick
+                            # (the ring engine's removal-after-send,
+                            # tick_core); removed senders still broadcast
+                            # this once but schedule no next trigger.
+                            rows = jnp.where(svalid, sids, n)
+                            rk = _sender_keys(base_key, _rng.OP_REMOVE,
+                                              stick2, rows)
+                            rem = (jax.vmap(
+                                lambda kk: jax.random.bernoulli(
+                                    kk, removal_p))(rk) & svalid) \
+                                if removal_p > 0.0 \
+                                else jnp.zeros((width,), bool)
+                            aflags = aflags.at[
+                                jnp.where(rem, sids, n)].add(
+                                REMOVED, mode="drop")
+                            strig = svalid & ~rem
+                        amail_ids, amail_cnt, adropped = append_messages(
+                            cfg, amail_ids, amail_cnt, adropped, sids,
+                            svalid, stick2, st.friends, st.friend_cnt,
+                            base_key, strig=strig)
+                        return (aflags, amail_ids, amail_cnt, adropped)
+                    return abody
 
-                flags, mail_ids, mail_cnt, dropped = jax.lax.fori_loop(
-                    0, nb, abody, (flags, mail_ids, mail_cnt, dropped))
+                nscap = narrow_tail_cap(scap)
+                if nscap:
+                    # Small remainders run as 1-2 narrow batches at
+                    # ~op-floor cost instead of one element-bound
+                    # full-width batch (narrow_tail_cap's rationale).
+                    rem = scnt % scap
+                    tail = rem <= 2 * nscap
+                    nfull = scnt // scap + jnp.where(tail, 0, 1)
+                    nnarrow = jnp.where(tail, (rem + nscap - 1) // nscap,
+                                        0)
+                else:
+                    nfull = (scnt + scap - 1) // scap
+                    nnarrow = None
+                carry = (flags, mail_ids, mail_cnt, dropped)
+                carry = jax.lax.fori_loop(
+                    0, nfull, make_abody(scap, lambda jb: jb * scap),
+                    carry)
+                if nscap:
+                    full_end = nfull * scap
+                    carry = jax.lax.fori_loop(
+                        0, nnarrow,
+                        make_abody(nscap,
+                                   lambda jb: full_end + jb * nscap),
+                        carry)
+                flags, mail_ids, mail_cnt, dropped = carry
                 return (flags, mail_ids, mail_cnt, dm, dr, dc, dropped)
             sticks = w * b + toff_s
             strig = None
