@@ -231,12 +231,9 @@ def _bench_overlay(cfg: Config) -> dict:
         s = JaxStepper(cfg)
         t0 = time.perf_counter()
         s.init()
-        windows = 0
-        while True:
-            _, _, q = s.overlay_window()
-            windows += 1
-            if q or windows >= 20_000:
-                break
+        # The quiet-run fast path (bounded device-side while_loop; what a
+        # quiet CLI run and the driver's bench invocation actually pay).
+        windows, q = s.overlay_run_to_quiescence(20_000)
         out.update(windows=windows, quiesced=bool(q),
                    stabilize_sim_ms=s.sim_time_ms())
         out[f"wall_s_{attempt}"] = round(time.perf_counter() - t0, 3)

@@ -52,6 +52,7 @@ class JaxStepper(Stepper):
                 self._oround = jax.jit(overlay.make_round_fn(cfg))
                 self.ostate = overlay.init_state(cfg)
             self._overlay_done = False
+            self._orun = None  # lazy: compiled only on the fast path
             self.state = None
         else:
             friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
@@ -79,17 +80,54 @@ class JaxStepper(Stepper):
         self._phase1_ms = (float(tick) if faithful
                            else self._overlay_rounds * self._mean_delay)
         if bool(q):
-            self._overlay_done = True
-            # Freeze phase-1 elapsed time: once the epidemic state exists,
-            # sim_time_ms switches to its tick (which starts at 0), so the
-            # driver's "Took Xms to stabilize" needs this snapshot.
-            self._stabilize_ms = self._phase1_ms
-            self._mailbox_dropped = int(jax.device_get(
-                self.ostate.mailbox_dropped))
-            self.state = self._engine.init_state(
-                self.cfg, self.ostate.friends, self.ostate.friend_cnt)
-            self.ostate = None  # free phase-1 buffers
+            self._finish_overlay()
         return int(mk), int(bk), bool(q)
+
+    def overlay_run_to_quiescence(self, max_windows: int,
+                                  budget: int = 256) -> tuple[int, bool]:
+        """Phase-1 fast path: bounded device-side while_loop to quiescence
+        (the overlay analog of run_to_target) -- one host sync per bounded
+        call instead of one jit dispatch + device_get per window, which
+        profiled at ~2.4x the device time through the TPU tunnel.
+        Trajectory-identical to the windowed loop (window-indexed keys,
+        same quiescence predicate); only for runs with nothing observing
+        per-window state (driver gates on printer.observing).  Returns
+        (windows_run, quiesced)."""
+        if self._overlay_done:
+            return 0, True
+        if self._orun is None:
+            self._orun = self._omod.make_run_fn(self.cfg)
+        # Default budget 256 windows/device call: sync cost amortizes to ~0.
+        q = False
+        while True:
+            lim = min(budget, max_windows - self._overlay_rounds)
+            if lim <= 0:
+                break
+            self.ostate, polls, q = self._orun(self.ostate, self.key,
+                                               np.int32(lim))
+            faithful = self._faithful_overlay
+            tick = self.ostate.tick if faithful else 0
+            polls, q, tick = jax.device_get((polls, q, tick))
+            self._overlay_rounds += int(polls)
+            self._phase1_ms = (float(tick) if faithful
+                               else self._overlay_rounds * self._mean_delay)
+            if bool(q):
+                break
+        if bool(q):
+            self._finish_overlay()
+        return self._overlay_rounds, bool(q)
+
+    def _finish_overlay(self) -> None:
+        self._overlay_done = True
+        # Freeze phase-1 elapsed time: once the epidemic state exists,
+        # sim_time_ms switches to its tick (which starts at 0), so the
+        # driver's "Took Xms to stabilize" needs this snapshot.
+        self._stabilize_ms = self._phase1_ms
+        self._mailbox_dropped = int(jax.device_get(
+            self.ostate.mailbox_dropped))
+        self.state = self._engine.init_state(
+            self.cfg, self.ostate.friends, self.ostate.friend_cnt)
+        self.ostate = None  # free phase-1 buffers
 
     # --- phase 2 ---------------------------------------------------------------
     def seed(self) -> None:

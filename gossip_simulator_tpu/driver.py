@@ -74,18 +74,32 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     if not resumed:
         printer.section("Constructing Overlay")
         max_overlay_windows = max(cfg.max_rounds, 1000)
-        while True:
-            makeups, breakups, quiesced = stepper.overlay_window()
-            overlay_windows += 1
-            if quiesced:
-                break
-            # Reference prints the window line only when *not* quiescing
-            # (simulator.go:227-230).
-            printer.overlay_window(breakups, makeups, stepper.sim_time_ms())
-            if overlay_windows >= max_overlay_windows:
+        # Same observability gate as the phase-2 fast path below: a quiet
+        # run has no per-window output, so stabilization can run as bounded
+        # device-side while_loops (one host sync per ~256 windows instead
+        # of one dispatch + device_get per 10 simulated ms).
+        if (not printer.observing
+                and hasattr(stepper, "overlay_run_to_quiescence")):
+            overlay_windows, oq = stepper.overlay_run_to_quiescence(
+                max_overlay_windows)
+            if not oq:
                 raise RuntimeError(
                     f"overlay did not stabilize within {max_overlay_windows} "
                     f"windows")
+        else:
+            while True:
+                makeups, breakups, quiesced = stepper.overlay_window()
+                overlay_windows += 1
+                if quiesced:
+                    break
+                # Reference prints the window line only when *not* quiescing
+                # (simulator.go:227-230).
+                printer.overlay_window(breakups, makeups,
+                                       stepper.sim_time_ms())
+                if overlay_windows >= max_overlay_windows:
+                    raise RuntimeError(
+                        f"overlay did not stabilize within "
+                        f"{max_overlay_windows} windows")
     stabilize_ms = 0.0 if resumed else stepper.sim_time_ms()
     if not resumed:
         printer.stabilized(stabilize_ms)

@@ -298,3 +298,33 @@ def quiesced(st: OverlayState) -> jnp.ndarray:
     happened yet)."""
     return ((st.win_makeups == 0) & (st.win_breakups == 0)
             & (pending_emissions(st) == 0) & (st.round > 0))
+
+
+def make_run_fn(cfg: Config):
+    """Up to `max_polls` rounds per device call, stopping early at
+    quiescence (see overlay_ticks.make_run_fn -- same rationale and the
+    same trajectory-identity argument; round keys are (base_key, round)-
+    indexed via st.round, not call-indexed)."""
+    import functools
+
+    round_fn = make_round_fn(cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_fn(st: OverlayState, base_key, max_polls):
+        """Returns (st, polls_run, quiesced) -- the flag rides the loop
+        carry so callers need no eager host-side quiesced() recompute
+        (pending_emissions reduces the full (n, cap)-sized emission
+        buffers; at large n that is an un-jitted multi-kernel dispatch)."""
+        def body(carry):
+            st, polls, _ = carry
+            st = round_fn(st, base_key)
+            return st, polls + 1, quiesced(st)
+
+        def cond(carry):
+            st, polls, q = carry
+            return (polls < max_polls) & ~q
+
+        return jax.lax.while_loop(
+            cond, body, (st, jnp.zeros((), I32), quiesced(st)))
+
+    return run_fn

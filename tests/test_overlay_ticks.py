@@ -151,3 +151,40 @@ def test_sharded_end_to_end_and_determinism():
     assert r1.stats == r2.stats
     assert r1.stabilize_ms == r2.stabilize_ms
     assert r1.stats.exchange_overflow == 0
+
+
+@pytest.mark.parametrize("overlay_mode", ["ticks", "rounds"])
+def test_fast_path_identical_to_windowed(overlay_mode):
+    """overlay_run_to_quiescence (the quiet-run bounded device loop) must
+    reproduce the windowed host loop exactly: same window count, same
+    stabilization clock, same friends table, same drop counter.  Keys are
+    window-indexed (not call-indexed) and the quiescence predicate runs on
+    the same post-window states, so the trajectories are one and the
+    same -- this pins that."""
+    def run(fast):
+        cfg = Config(**{**BASE, "overlay_mode": overlay_mode}).validate()
+        s = JaxStepper(cfg)
+        s.init()
+        if fast:
+            # Small per-call budget: forces several bounded re-entries so
+            # the host re-entry seam (budget clamp, counter carry) is
+            # covered, not just the single-call case.
+            windows, q = s.overlay_run_to_quiescence(3000, budget=8)
+        else:
+            windows, q = 0, False
+            for _ in range(3000):
+                _, _, q = s.overlay_window()
+                windows += 1
+                if q:
+                    break
+        assert q
+        return (windows, s.sim_time_ms(), s._mailbox_dropped,
+                np.asarray(s.state.friends), np.asarray(s.state.friend_cnt))
+
+    wf, tf, df, ff, cf = run(fast=True)
+    ww, tw, dw, fw, cw = run(fast=False)
+    assert wf == ww
+    assert tf == tw
+    assert df == dw
+    np.testing.assert_array_equal(ff, fw)
+    np.testing.assert_array_equal(cf, cw)
