@@ -494,6 +494,20 @@ def narrow_tail_cap(scap: int) -> int:
     return ns if ns >= 1024 else 0
 
 
+def narrow_tail_trips(count, scap: int, nscap: int):
+    """Trip counts (nfull, nnarrow) covering `count` senders: full-width
+    batches, then -- when the remainder fits 1-2 narrow batches -- the
+    narrow tail; larger remainders keep one more full-width batch.  The
+    ONE scheduling rule shared by the single-device and sharded steps
+    (sharded passes the pmax-agreed count so collective counts stay
+    uniform across shards); `count` is a traced scalar."""
+    rem = count % scap
+    tail = rem <= 2 * nscap
+    nfull = count // scap + jnp.where(tail, 0, 1)
+    nnarrow = jnp.where(tail, (rem + nscap - 1) // nscap, 0)
+    return nfull, nnarrow
+
+
 def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb,
                  lo=None):
     """Extract compacted sender batch `jb`: rows with rank in
@@ -594,11 +608,7 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                     # Small remainders run as 1-2 narrow batches at
                     # ~op-floor cost instead of one element-bound
                     # full-width batch (narrow_tail_cap's rationale).
-                    rem = scnt % scap
-                    tail = rem <= 2 * nscap
-                    nfull = scnt // scap + jnp.where(tail, 0, 1)
-                    nnarrow = jnp.where(tail, (rem + nscap - 1) // nscap,
-                                        0)
+                    nfull, nnarrow = narrow_tail_trips(scnt, scap, nscap)
                 else:
                     nfull = (scnt + scap - 1) // scap
                     nnarrow = None

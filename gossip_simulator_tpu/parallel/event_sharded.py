@@ -8,9 +8,12 @@ the ICI replacement for the reference's shared `GlobalView[id].ch <- msg`
 sends (simulator.go:145).  Collective counts are pmax-agreed at BOTH
 levels so every shard executes the same number: drain chunks per window,
 and -- when sender compaction engages (event.sender_compaction_cap) --
-ceil(pmax(senders)/scap) emission batches per chunk, each routing one
-all_to_all with a zero-loss scap*kwidth per-pair buffer (degree <= 2
-configs emit one full-width all_to_all per chunk as before).
+emission batches per chunk scheduled by the shared
+event.narrow_tail_trips rule on pmax(senders): full scap-wide batches
+plus, for small remainders, 1-2 narrow scap/8-wide tail batches, each
+batch routing one all_to_all with a zero-loss width*kwidth per-pair
+buffer (degree <= 2 configs emit one full-width all_to_all per chunk as
+before).
 
 Wire format: one int32 per message, `dst_local * (dw*B) + wslot * B + off`
 (destination's local row, arrival window slot, tick offset).  Requires
@@ -254,18 +257,43 @@ def make_sharded_event_step(cfg: Config, mesh):
                 srank = jnp.cumsum(senders.astype(I32)) - 1
                 scnt = senders.sum(dtype=I32)
                 spacked = ids_s * b + toff_s
-                nb = (jax.lax.pmax(scnt, AXIS) + scap - 1) // scap
+                smax = jax.lax.pmax(scnt, AXIS)
 
-                def abody(jb, acarry):
-                    aflags, amail, acnt, adropped, axovf = acarry
-                    bids, btoff, bvalid = event.sender_batch(
-                        senders, srank, scnt, spacked, b, scap, jb)
-                    return emit(aflags, amail, acnt, adropped, axovf,
-                                bids, bvalid, w * b + btoff, scap,
-                                rcap_c)
+                def make_abody(width, ecap, lo_of):
+                    def abody(jb, acarry):
+                        aflags, amail, acnt, adropped, axovf = acarry
+                        bids, btoff, bvalid = event.sender_batch(
+                            senders, srank, scnt, spacked, b, width, jb,
+                            lo=lo_of(jb))
+                        return emit(aflags, amail, acnt, adropped, axovf,
+                                    bids, bvalid, w * b + btoff, width,
+                                    ecap)
+                    return abody
 
-                flags, mail, cnt, dropped, xovf = jax.lax.fori_loop(
-                    0, nb, abody, (flags, mail, cnt, dropped, xovf))
+                # Narrow-tail batching (event.narrow_tail_cap): both trip
+                # counts derive from the pmax-agreed smax via the SHARED
+                # schedule (event.narrow_tail_trips), so every shard still
+                # runs the same number of all_to_alls.  The narrow ecap is
+                # the same zero-loss per-pair bound at the reduced width.
+                nscap = event.narrow_tail_cap(scap)
+                if nscap:
+                    nfull, nnarrow = event.narrow_tail_trips(
+                        smax, scap, nscap)
+                else:
+                    nfull = (smax + scap - 1) // scap
+                    nnarrow = None
+                carry = (flags, mail, cnt, dropped, xovf)
+                carry = jax.lax.fori_loop(
+                    0, nfull,
+                    make_abody(scap, rcap_c, lambda jb: jb * scap), carry)
+                if nscap:
+                    full_end = nfull * scap
+                    carry = jax.lax.fori_loop(
+                        0, nnarrow,
+                        make_abody(nscap, nscap * kwidth,
+                                   lambda jb: full_end + jb * nscap),
+                        carry)
+                flags, mail, cnt, dropped, xovf = carry
             else:
                 flags, mail, cnt, dropped, xovf = emit(
                     flags, mail, cnt, dropped, xovf, ids_s, senders,
