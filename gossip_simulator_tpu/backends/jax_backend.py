@@ -84,7 +84,8 @@ class JaxStepper(Stepper):
         return int(mk), int(bk), bool(q)
 
     def overlay_run_to_quiescence(self, max_windows: int,
-                                  budget: int = 256) -> tuple[int, bool]:
+                                  budget: int | None = None
+                                  ) -> tuple[int, bool]:
         """Phase-1 fast path: bounded device-side while_loop to quiescence
         (the overlay analog of run_to_target) -- one host sync per bounded
         call instead of one jit dispatch + device_get per window, which
@@ -97,7 +98,10 @@ class JaxStepper(Stepper):
             return 0, True
         if self._orun is None:
             self._orun = self._omod.make_run_fn(self.cfg)
-        # Default budget 256 windows/device call: sync cost amortizes to ~0.
+        if budget is None:
+            # Watchdog-bounded windows per device call; the calibration
+            # lives with each overlay module's cost model.
+            budget = self._omod.run_call_budget(self.cfg)
         q = False
         while True:
             lim = min(budget, max_windows - self._overlay_rounds)

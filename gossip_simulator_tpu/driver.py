@@ -76,8 +76,9 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         max_overlay_windows = max(cfg.max_rounds, 1000)
         # Same observability gate as the phase-2 fast path below: a quiet
         # run has no per-window output, so stabilization can run as bounded
-        # device-side while_loops (one host sync per ~256 windows instead
-        # of one dispatch + device_get per 10 simulated ms).
+        # device-side while_loops (one host sync per watchdog-bounded call
+        # -- overlay_ticks/overlay.run_call_budget windows -- instead of
+        # one dispatch + device_get per 10 simulated ms).
         if (not printer.observing
                 and hasattr(stepper, "overlay_run_to_quiescence")):
             overlay_windows, oq = stepper.overlay_run_to_quiescence(

@@ -508,6 +508,28 @@ def narrow_tail_trips(count, scap: int, nscap: int):
     return nfull, nnarrow
 
 
+def run_narrow_tail(make_abody, carry, count, scap: int):
+    """Drive the batched append schedule: full scap-wide batches, then --
+    when narrow_tail_cap engages -- the 1-2 narrow tail batches.  The ONE
+    driver shared by the single-device and sharded steps; `make_abody`
+    builds a fori body for a (width, lo_of) pair, `count` is the (traced)
+    sender count -- pmax-agreed by the sharded caller so collective
+    counts stay uniform."""
+    nscap = narrow_tail_cap(scap)
+    if nscap:
+        nfull, nnarrow = narrow_tail_trips(count, scap, nscap)
+    else:
+        nfull = (count + scap - 1) // scap
+    carry = jax.lax.fori_loop(
+        0, nfull, make_abody(scap, lambda jb: jb * scap), carry)
+    if nscap:
+        full_end = nfull * scap
+        carry = jax.lax.fori_loop(
+            0, nnarrow,
+            make_abody(nscap, lambda jb: full_end + jb * nscap), carry)
+    return carry
+
+
 def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb,
                  lo=None):
     """Extract compacted sender batch `jb`: rows with rank in
@@ -603,27 +625,12 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                         return (aflags, amail_ids, amail_cnt, adropped)
                     return abody
 
-                nscap = narrow_tail_cap(scap)
-                if nscap:
-                    # Small remainders run as 1-2 narrow batches at
-                    # ~op-floor cost instead of one element-bound
-                    # full-width batch (narrow_tail_cap's rationale).
-                    nfull, nnarrow = narrow_tail_trips(scnt, scap, nscap)
-                else:
-                    nfull = (scnt + scap - 1) // scap
-                    nnarrow = None
-                carry = (flags, mail_ids, mail_cnt, dropped)
-                carry = jax.lax.fori_loop(
-                    0, nfull, make_abody(scap, lambda jb: jb * scap),
-                    carry)
-                if nscap:
-                    full_end = nfull * scap
-                    carry = jax.lax.fori_loop(
-                        0, nnarrow,
-                        make_abody(nscap,
-                                   lambda jb: full_end + jb * nscap),
-                        carry)
-                flags, mail_ids, mail_cnt, dropped = carry
+                # Small remainders run as 1-2 narrow batches at ~op-floor
+                # cost instead of one element-bound full-width batch
+                # (narrow_tail_cap's rationale; run_narrow_tail drives).
+                flags, mail_ids, mail_cnt, dropped = run_narrow_tail(
+                    make_abody, (flags, mail_ids, mail_cnt, dropped),
+                    scnt, scap)
                 return (flags, mail_ids, mail_cnt, dm, dr, dc, dropped)
             sticks = w * b + toff_s
             strig = None

@@ -300,6 +300,13 @@ def quiesced(st: OverlayState) -> jnp.ndarray:
             & (pending_emissions(st) == 0) & (st.round > 0))
 
 
+def run_call_budget(cfg: Config) -> int:
+    """Rounds per bounded overlay_run_to_quiescence device call (see
+    overlay_ticks.run_call_budget for the watchdog calibration); a round
+    here costs ~0.2 us/node, half the ticks-mode window."""
+    return max(1, min(1024, int(4e7 // max(cfg.n, 1))))
+
+
 def make_run_fn(cfg: Config):
     """Up to `max_polls` rounds per device call, stopping early at
     quiescence (see overlay_ticks.make_run_fn -- same rationale and the

@@ -387,6 +387,16 @@ def quiesced(st: OverlayTickState) -> jnp.ndarray:
             & ~jnp.any(st.ring_cnt > 0) & (st.tick > 0))
 
 
+def run_call_budget(cfg: Config) -> int:
+    """Poll windows per bounded overlay_run_to_quiescence device call.
+    One call must stay under the device-runtime watchdog (the failure
+    mode epidemic.run_call_budget documents; calibrated here 2026-07-31
+    at n=1e7 on v5e: 4-window ~16 s calls get the worker killed as
+    UNAVAILABLE, 2-window ~8 s calls run clean).  Target <= ~8 s/call at
+    the measured ~0.4 us/node/window."""
+    return max(1, min(1024, int(2e7 // max(cfg.n, 1))))
+
+
 def make_run_fn(cfg: Config):
     """Up to `max_polls` poll windows per device call, stopping early at
     quiescence -- the phase-1 analog of the epidemic's bounded

@@ -170,12 +170,12 @@ def make_sharded_event_step(cfg: Config, mesh):
         ckey = _rng.tick_key(skey, w, _rng.OP_CRASH)
         kwidth = st.friends.shape[1]
         rcap = min(exchange.epidemic_cap(n_local, kwidth, s), ccap * kwidth)
-        # Compacted batches carry at most scap senders; scap * kwidth is
-        # the ZERO-LOSS per-pair buffer (a batch cannot emit more edges
+        # Compacted batches carry at most `width` senders; width * kwidth
+        # is the ZERO-LOSS per-pair buffer (a batch cannot emit more edges
         # than that), matching the dense path's effective lossless
         # ccap * kwidth -- an epidemic_cap-style mean*safety bound would
-        # drop skewed batches at n_shards > 4.
-        rcap_c = scap * kwidth if scap else 0
+        # drop skewed batches at n_shards > 4.  Computed per batch width
+        # in make_abody (full scap and narrow scap/8 widths).
         cap = (st.mail_ids.shape[0] - ccap) // dw
 
         def emit(flags, mail, cnt, dropped, xovf, sids, svalid, sticks,
@@ -259,7 +259,9 @@ def make_sharded_event_step(cfg: Config, mesh):
                 spacked = ids_s * b + toff_s
                 smax = jax.lax.pmax(scnt, AXIS)
 
-                def make_abody(width, ecap, lo_of):
+                def make_abody(width, lo_of):
+                    # width * kwidth: zero-loss per-pair receive buffer
+                    # at this batch width (see the step-level comment).
                     def abody(jb, acarry):
                         aflags, amail, acnt, adropped, axovf = acarry
                         bids, btoff, bvalid = event.sender_batch(
@@ -267,33 +269,15 @@ def make_sharded_event_step(cfg: Config, mesh):
                             lo=lo_of(jb))
                         return emit(aflags, amail, acnt, adropped, axovf,
                                     bids, bvalid, w * b + btoff, width,
-                                    ecap)
+                                    width * kwidth)
                     return abody
 
-                # Narrow-tail batching (event.narrow_tail_cap): both trip
-                # counts derive from the pmax-agreed smax via the SHARED
-                # schedule (event.narrow_tail_trips), so every shard still
-                # runs the same number of all_to_alls.  The narrow ecap is
-                # the same zero-loss per-pair bound at the reduced width.
-                nscap = event.narrow_tail_cap(scap)
-                if nscap:
-                    nfull, nnarrow = event.narrow_tail_trips(
-                        smax, scap, nscap)
-                else:
-                    nfull = (smax + scap - 1) // scap
-                    nnarrow = None
-                carry = (flags, mail, cnt, dropped, xovf)
-                carry = jax.lax.fori_loop(
-                    0, nfull,
-                    make_abody(scap, rcap_c, lambda jb: jb * scap), carry)
-                if nscap:
-                    full_end = nfull * scap
-                    carry = jax.lax.fori_loop(
-                        0, nnarrow,
-                        make_abody(nscap, nscap * kwidth,
-                                   lambda jb: full_end + jb * nscap),
-                        carry)
-                flags, mail, cnt, dropped, xovf = carry
+                # Shared schedule + driver (event.run_narrow_tail) on the
+                # pmax-agreed smax, so every shard still runs the same
+                # number of all_to_alls.
+                flags, mail, cnt, dropped, xovf = event.run_narrow_tail(
+                    make_abody, (flags, mail, cnt, dropped, xovf), smax,
+                    scap)
             else:
                 flags, mail, cnt, dropped, xovf = emit(
                     flags, mail, cnt, dropped, xovf, ids_s, senders,
