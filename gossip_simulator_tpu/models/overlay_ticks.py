@@ -15,7 +15,7 @@ one window always arrives in a later one):
   1. drain this window's ring slot; stable-sort entries by arrival tick so
      per-node mailbox order is arrival order;
   2. deliver breakups / makeups into fixed-capacity mailboxes
-     (ops/mailbox.deliver) and process them slot-sequentially,
+     (ops/mailbox.deliver_pair) and process them slot-sequentially,
      node-parallel with the SAME per-message decision rules as the round
      engine (accept-under-fanin / evict-random / replace-on-breakup,
      simulator.go:66-94);
@@ -47,7 +47,7 @@ from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models.overlay import (delivery_chunk,
                                                  process_breakup_slot,
                                                  process_makeup_slot)
-from gossip_simulator_tpu.ops.mailbox import deliver
+from gossip_simulator_tpu.ops.mailbox import deliver_pair
 from gossip_simulator_tpu.ops.select import first_true_indices
 from gossip_simulator_tpu.utils import rng as _rng
 
@@ -250,10 +250,11 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
             return _emit_all(cfg, ring, base_key, w, em_dst, em_toff,
                              typ, op)
 
-    def _deliver(src_pay, dst, valid):
-        mbox, _, drp = deliver(src_pay, dst, valid, n_rows, cap_mb,
-                               compact_chunk=dchunk)
-        return mbox, drp
+    def _deliver_both(src_pay, dst, typ, evalid):
+        # Both message types in ONE sorted pass (ops.mailbox.deliver_pair;
+        # bit-identical to two deliver() calls at ~half the op count).
+        return deliver_pair(src_pay, dst, typ, evalid, n_rows, cap_mb,
+                            compact_chunk=dchunk)
 
     def step_fn(st: OverlayTickState, base_key: jax.Array) -> OverlayTickState:
         w = st.tick // b
@@ -269,9 +270,8 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         evalid = toff_key < b
         typ = (pay_e // b) % 2
         mbox_pay = (pay_e // (2 * b)) * b + pay_e % b  # src*b + toff
-        mk_mbox, drop1 = _deliver(mbox_pay, dst_e, evalid & (typ == MK))
-        bk_mbox, drop2 = _deliver(mbox_pay, dst_e, evalid & (typ == BK))
-        local_drops = drop1 + drop2
+        mk_mbox, bk_mbox, local_drops = _deliver_both(
+            mbox_pay, dst_e, typ, evalid)
         ring_cnt = st.ring_cnt.at[0, slot].set(0)
 
         rkey = key_fn(base_key, w, _rng.OP_REPLACE)

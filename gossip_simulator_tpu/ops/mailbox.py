@@ -153,8 +153,52 @@ def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
     return mbox, count, dropped
 
 
-def _deliver_compact(src, dst, valid, n, cap, chunk):
-    """Chunked-compacted deliver (see deliver's compact_chunk)."""
+def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
+                 compact_chunk: int | None = None):
+    """Deliver a two-TYPE message stream into two mailbox sets in ONE
+    sorted pass: key (typ, dst) packed as typ*n + dst, shared compaction,
+    one stable sort, one scatter into a stacked [2n, cap] buffer split
+    afterwards.  Bit-identical mailboxes to two deliver() calls with
+    valid = evalid & (typ == t): the stable sort keeps within-(typ, dst)
+    arrival order, and removing the other type's entries from a stably
+    ordered stream does not reorder the survivors -- at roughly half the
+    per-chunk op count (ONE full-width compaction scan / sort / scatter /
+    count-add where two delivers each paid their own).
+
+    Requires flat addressing for the stacked buffer, (2n+1)*cap < 2^31;
+    past that it falls back to two deliver() calls (which carry their own
+    dense-fallback warning).  Returns (mbox_t0, mbox_t1, dropped)."""
+    if not flat_addressing_fits(2 * n + 1, cap):
+        m0, _, d0 = deliver(src, dst, evalid & (typ == 0), n, cap,
+                            compact_chunk)
+        m1, _, d1 = deliver(src, dst, evalid & (typ == 1), n, cap,
+                            compact_chunk)
+        return m0, m1, d0 + d1
+    m = src.shape[0]
+    n2 = 2 * n
+    key_full = jnp.where(evalid, typ * n + dst, n2).astype(jnp.int32)
+    if compact_chunk is not None and compact_chunk < m:
+        mbox, _, dropped = _deliver_compact_keyed(
+            src, key_full, evalid, n2, cap, compact_chunk)
+    else:
+        sd, ss = jax.lax.sort((key_full, src.astype(jnp.int32)),
+                              num_keys=1, is_stable=True)
+        rank = segment_ranks(sd)
+        ok = (sd < n2) & (rank < cap)
+        flat = jnp.where(ok, sd * cap + rank, n2 * cap)
+        mbox = jnp.full((n2 * cap + 1,), -1, dtype=jnp.int32)
+        mbox = mbox.at[flat].set(jnp.where(ok, ss, -1))[:n2 * cap]
+        dropped = ((sd < n2) & (rank >= cap)).sum(dtype=jnp.int32)
+    return (mbox[:n * cap].reshape(n, cap),
+            mbox[n * cap:n2 * cap].reshape(n, cap), dropped)
+
+
+def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk):
+    """Chunked-compacted delivery on a prepacked key in [0, nk] (nk =
+    invalid sentinel) -- the ONE chunked work-horse behind both
+    _deliver_compact (key = dst) and deliver_pair (key = typ*n + dst).
+    Returns the flat (nk*cap + 1) mailbox incl. trash cell, the
+    TOTAL-arrivals count array (nk + 1), and the drop count."""
     m = src.shape[0]
     total = valid.sum(dtype=jnp.int32)
     chunks = (total + chunk - 1) // chunk
@@ -166,24 +210,30 @@ def _deliver_compact(src, dst, valid, n, cap, chunk):
         remaining = remaining & ~hit
         v = idx < m
         s = src.at[idx].get(mode="fill", fill_value=-1)
-        d = dst.at[idx].get(mode="fill", fill_value=0)
-        key = jnp.where(v, d, n).astype(jnp.int32)
+        key = key_full.at[idx].get(mode="fill", fill_value=nk)
+        key = jnp.where(v, key, nk)
         sd, ss = jax.lax.sort((key, s.astype(jnp.int32)), num_keys=1,
                               is_stable=True)
-        rank = segment_ranks(sd) + count[jnp.minimum(sd, n)]
-        ok = (sd < n) & (rank < cap)
-        flat = jnp.where(ok, sd * cap + rank, n * cap)
+        rank = segment_ranks(sd) + count[jnp.minimum(sd, nk)]
+        ok = (sd < nk) & (rank < cap)
+        flat = jnp.where(ok, sd * cap + rank, nk * cap)
         mbox = mbox.at[flat].set(jnp.where(ok, ss, -1))
-        # count tracks TOTAL arrivals (including beyond-cap) so later
-        # chunks' ranks continue exactly where a single pass would be.
-        count = count.at[jnp.where(sd < n, sd, n)].add(1)
-        dropped = dropped + ((sd < n) & (rank >= cap)).sum(dtype=jnp.int32)
+        count = count.at[jnp.where(sd < nk, sd, nk)].add(1)
+        dropped = dropped + ((sd < nk) & (rank >= cap)).sum(dtype=jnp.int32)
         return mbox, count, dropped, remaining
 
-    mbox0 = jnp.full((n * cap + 1,), -1, dtype=jnp.int32)
-    count0 = jnp.zeros((n + 1,), dtype=jnp.int32)
+    mbox0 = jnp.full((nk * cap + 1,), -1, dtype=jnp.int32)
+    count0 = jnp.zeros((nk + 1,), dtype=jnp.int32)
     mbox, count, dropped, _ = jax.lax.fori_loop(
         0, chunks, body,
         (mbox0, count0, jnp.zeros((), jnp.int32), valid))
+    return mbox, count, dropped
+
+
+def _deliver_compact(src, dst, valid, n, cap, chunk):
+    """Chunked-compacted deliver (see deliver's compact_chunk)."""
+    key_full = jnp.where(valid, dst, n).astype(jnp.int32)
+    mbox, count, dropped = _deliver_compact_keyed(
+        src, key_full, valid, n, cap, chunk)
     return (mbox[:n * cap].reshape(n, cap),
             jnp.minimum(count[:n], cap), dropped)

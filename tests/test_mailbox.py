@@ -108,3 +108,29 @@ def test_deliver_cap8_no_drops_at_overlay_load():
                                 compact_chunk=4096)
     assert int(dropped) == 0
     assert int(np.asarray(count).sum()) == n
+
+
+def test_deliver_pair_matches_two_delivers():
+    """deliver_pair must reproduce two masked deliver() calls exactly --
+    mailbox contents and total drops -- across densities, duplicate
+    destinations, over-cap overflow, and both the compacted and
+    single-pass paths."""
+    from gossip_simulator_tpu.ops.mailbox import deliver_pair
+
+    rng = np.random.default_rng(7)
+    n, cap, m = 50, 3, 400
+    for compact in (None, 64):
+        for density in (0.05, 0.5, 1.0):
+            src = jnp.asarray(rng.integers(0, 1000, m).astype(np.int32))
+            dst = jnp.asarray(rng.integers(0, n, m).astype(np.int32))
+            typ = jnp.asarray(rng.integers(0, 2, m).astype(np.int32))
+            ev = jnp.asarray(rng.random(m) < density)
+            m0, _, d0 = deliver(src, dst, ev & (typ == 0), n, cap,
+                                compact_chunk=compact)
+            m1, _, d1 = deliver(src, dst, ev & (typ == 1), n, cap,
+                                compact_chunk=compact)
+            p0, p1, dp = deliver_pair(src, dst, typ, ev, n, cap,
+                                      compact_chunk=compact)
+            np.testing.assert_array_equal(np.asarray(m0), np.asarray(p0))
+            np.testing.assert_array_equal(np.asarray(m1), np.asarray(p1))
+            assert int(d0) + int(d1) == int(dp)
