@@ -241,7 +241,8 @@ def _bench_overlay(cfg: Config) -> dict:
 
 
 def full_suite(seed: int) -> list[dict]:
-    """BASELINE.json configs 1-4 plus one overlay phase-1 timing row, on
+    """BASELINE.json configs 1-4 plus two overlay phase-1 timing rows
+    (default rounds mode and the tick-faithful engine), on
     this host's devices.  Config 5 (100M sharded on v5e-8) needs an 8-chip
     slice; run it via `-backend sharded` on such a host -- see
     tests/test_sharded.py for the 8-fake-device CPU rehearsal."""
@@ -290,16 +291,21 @@ def full_suite(seed: int) -> list[dict]:
         r["config"] = name
         r["wall_s"] = round(time.perf_counter() - t0, 3)
         out.append(r)
-    # Overlay phase-1 timing row (the reference's "Constructing Overlay"
-    # phase, simulator.go:219-235): 1M nodes single-chip, default mode.
-    try:
-        ocfg = Config(n=1_000_000 // scale, graph="overlay", backend="jax",
-                      seed=seed, progress=False).validate()
-        r = _bench_overlay(ocfg)
-    except Exception as e:
-        r = {"error": repr(e)}
-    r["config"] = "overlay_1m_phase1"
-    out.append(r)
+    # Overlay phase-1 timing rows (the reference's "Constructing Overlay"
+    # phase, simulator.go:219-235): 1M nodes single-chip, default rounds
+    # mode AND the tick-faithful engine (per-message delays, the
+    # reference's true stabilization clock -- `-overlay-mode ticks`).
+    for name, mode in (("overlay_1m_phase1", "rounds"),
+                       ("overlay_1m_ticks", "ticks")):
+        try:
+            ocfg = Config(n=1_000_000 // scale, graph="overlay",
+                          overlay_mode=mode, backend="jax",
+                          seed=seed, progress=False).validate()
+            r = _bench_overlay(ocfg)
+        except Exception as e:
+            r = {"error": repr(e)}
+        r["config"] = name
+        out.append(r)
     return out
 
 

@@ -226,8 +226,19 @@ class Config:
         # to 8 there (still above the max-load bound; overflow is counted,
         # never silent), which keeps flat addressing to n_rows ~ 2.7e8.
         # Beyond THAT the dense fallback engages and deliver's one-time
-        # warning names it.
-        if (n_rows + 1) * 16 >= 2**31:
+        # warning names it.  The tick-faithful engine's fused delivery
+        # (ops/mailbox.deliver_pair) additionally wants the STACKED
+        # [2n, cap] addressing, so ticks mode shrinks at HALF that
+        # boundary (~6.7e7) -- keeping the one-pass path to ~1.34e8 (the
+        # 100M flagship); its fallback past the shrunk bound is two
+        # deliver() passes, not the dense path.
+        from gossip_simulator_tpu.ops.mailbox import flat_addressing_fits
+
+        # EXACTLY the gates the delivery paths consult (deliver_pair
+        # checks fits(2n+1, cap); deliver checks fits(n, cap)) so the two
+        # bounds can never drift by an off-by-one.
+        rows = 2 * n_rows + 1 if self.overlay_mode == "ticks" else n_rows
+        if not flat_addressing_fits(rows, 16):
             return 8
         return 16
 

@@ -30,8 +30,11 @@ _warned_dense_fallback = False
 def flat_addressing_fits(n: int, cap: int) -> bool:
     """True iff the [n, cap] mailbox can use flat int32 addressing (the fast
     sort + 1-D-scatter delivery paths; index n*cap is the trash cell).  The
-    auto mailbox cap (Config.mailbox_cap_resolved) shrinks 16 -> 8 past
-    n ~ 1.34e8 precisely to keep this true up to n ~ 2.7e8."""
+    auto mailbox cap (Config.mailbox_cap_resolved) shrinks 16 -> 8 right
+    where its engine's gate stops fitting -- past n ~ 1.34e8 in rounds
+    mode (single [n, cap] arrays, flat to n ~ 2.7e8 at cap 8), past
+    n ~ 6.7e7 in ticks mode (deliver_pair's stacked [2n, cap] buffer,
+    one-pass to n ~ 1.34e8 at cap 8)."""
     return (n + 1) * cap < 2**31
 
 

@@ -134,3 +134,21 @@ def test_deliver_pair_matches_two_delivers():
             np.testing.assert_array_equal(np.asarray(m0), np.asarray(p0))
             np.testing.assert_array_equal(np.asarray(m1), np.asarray(p1))
             assert int(d0) + int(d1) == int(dp)
+
+
+def test_auto_mailbox_cap_decliff_ticks_mode():
+    """Ticks mode shrinks the auto cap at HALF the rounds-mode boundary
+    (~6.7e7): deliver_pair's stacked [2n, cap] flat addressing must fit,
+    keeping the fused one-pass delivery to the 100M flagship scale."""
+    from gossip_simulator_tpu.config import Config
+    from gossip_simulator_tpu.ops.mailbox import flat_addressing_fits
+
+    def cap(n, mode):
+        return Config(n=n, overlay_mode=mode).mailbox_cap_resolved
+
+    assert cap(67_000_000, "ticks") == 16
+    assert cap(68_000_000, "ticks") == 8        # stacked 16 would overflow
+    assert cap(68_000_000, "rounds") == 16      # rounds keeps single-array
+    assert cap(134_000_000, "ticks") == 8
+    # The shrunk cap keeps the STACKED addressing flat to ~1.34e8.
+    assert flat_addressing_fits(2 * 134_000_000 + 1, 8)
