@@ -6,8 +6,11 @@ toff over the full static slot cap; toff has only b+1 distinct values, so a
 counting sort -- one-hot rank + per-bucket exclusive prefix + one
 permutation scatter per carried array -- produces the IDENTICAL stable
 permutation (asserted here) at bandwidth cost instead of log^2 sort passes.
-README roadmap records the shipping gate: must win at the 1M/10M overlay
-cap widths before replacing the measured ticks-mode rows.
+VERDICT (2026-07-31, recorded in the README roadmap): the counting form
+LOSES at both shipping widths (0.31x at 2.5M lanes, 0.23x at 10M on
+v5e), and the chunked occupancy-scaled variant is a wash at best -- the
+3-operand lax.sort is essentially flat in occupancy.  Kept as the
+measurement harness backing that dead-end record.
 
 Usage: python scripts/sort_vs_counting.py [--cap 2500000] [--b 10]
        [--occupancy 0.3] [--reps 10]
