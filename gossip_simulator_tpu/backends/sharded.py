@@ -98,34 +98,78 @@ class ShardedStepper(Stepper):
             self._overlay_done = True
 
     # --- phase 1 ---------------------------------------------------------------
+    def _overlay_mod(self):
+        if getattr(self, "_faithful_overlay", False):
+            from gossip_simulator_tpu.models import overlay_ticks
+
+            return overlay_ticks
+        return overlay
+
     def overlay_window(self) -> tuple[int, int, bool]:
         if self._overlay_done:
             return 0, 0, True
         self.ostate = self._oround(self.ostate, self.key)
         self._overlay_rounds += 1
         faithful = getattr(self, "_faithful_overlay", False)
-        if faithful:
-            from gossip_simulator_tpu.models import overlay_ticks
-
-            quiesced = overlay_ticks.quiesced(self.ostate)
-            tick = self.ostate.tick
-        else:
-            quiesced = overlay.quiesced(self.ostate)
-            tick = 0
+        quiesced = self._overlay_mod().quiesced(self.ostate)
+        tick = self.ostate.tick if faithful else 0
         mk, bk, q, tick = jax.device_get(
             (self.ostate.win_makeups, self.ostate.win_breakups,
              quiesced, tick))
         self._phase1_ms = (float(tick) if faithful
                            else self._overlay_rounds * self._mean_delay)
         if bool(q):
-            self._overlay_done = True
-            # Freeze phase-1 elapsed time (see JaxStepper.overlay_window).
-            self._stabilize_ms = self._phase1_ms
-            self._mailbox_dropped = int(
-                jax.device_get(self.ostate.mailbox_dropped))
-            self.state = self._epidemic_from_overlay()
-            self.ostate = None
+            self._finish_overlay()
         return int(mk), int(bk), bool(q)
+
+    def overlay_run_to_quiescence(self, max_windows: int,
+                                  budget: int | None = None
+                                  ) -> tuple[int, bool]:
+        """Phase-1 fast path for quiet runs (see JaxStepper's method --
+        same contract, same driver gate).  The bounded while_loop wraps
+        the jitted shard_map'd poll OUTSIDE shard_map: the quiescence
+        counters are replicated on the outer state (psum'd inside the
+        poll), so the loop condition is mesh-uniform by construction and
+        every shard runs the same trip count."""
+        if self._overlay_done:
+            return 0, True
+        omod = self._overlay_mod()
+        if getattr(self, "_orun", None) is None:
+            self._orun = overlay.make_bounded_run(self._oround,
+                                                  omod.quiesced)
+        if budget is None:
+            # Per-call device work scales with the SHARD slice, so the
+            # single-chip watchdog budget stretches by the shard count
+            # (scaled inside run_call_budget, before its >=1 clamp).
+            budget = omod.run_call_budget(self.cfg,
+                                          shards=self.mesh.shape[AXIS])
+        faithful = getattr(self, "_faithful_overlay", False)
+        q = False
+        while True:
+            lim = min(budget, max_windows - self._overlay_rounds)
+            if lim <= 0:
+                break
+            self.ostate, polls, q = self._orun(self.ostate, self.key,
+                                               np.int32(lim))
+            tick = self.ostate.tick if faithful else 0
+            polls, q, tick = jax.device_get((polls, q, tick))
+            self._overlay_rounds += int(polls)
+            self._phase1_ms = (float(tick) if faithful
+                               else self._overlay_rounds * self._mean_delay)
+            if bool(q):
+                break
+        if bool(q):
+            self._finish_overlay()
+        return self._overlay_rounds, bool(q)
+
+    def _finish_overlay(self) -> None:
+        self._overlay_done = True
+        # Freeze phase-1 elapsed time (see JaxStepper.overlay_window).
+        self._stabilize_ms = self._phase1_ms
+        self._mailbox_dropped = int(
+            jax.device_get(self.ostate.mailbox_dropped))
+        self.state = self._epidemic_from_overlay()
+        self.ostate = None
 
     def _epidemic_from_overlay(self):
         cfg, mesh = self.cfg, self.mesh

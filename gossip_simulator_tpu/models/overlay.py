@@ -300,38 +300,48 @@ def quiesced(st: OverlayState) -> jnp.ndarray:
             & (pending_emissions(st) == 0) & (st.round > 0))
 
 
-def run_call_budget(cfg: Config) -> int:
+def run_call_budget(cfg: Config, shards: int = 1) -> int:
     """Rounds per bounded overlay_run_to_quiescence device call (see
     overlay_ticks.run_call_budget for the watchdog calibration); a round
-    here costs ~0.2 us/node, half the ticks-mode window."""
-    return max(1, min(1024, int(4e7 // max(cfg.n, 1))))
+    here costs ~0.2 us/node, half the ticks-mode window.  `shards`
+    scales the budget for a mesh backend (per-call device work tracks
+    the per-SHARD slice) -- it multiplies BEFORE the >=1 clamp so large
+    n keeps the ratio instead of collapsing to 1*shards."""
+    return max(1, min(1024, int(4e7 * shards // max(cfg.n, 1))))
 
 
-def make_run_fn(cfg: Config):
-    """Up to `max_polls` rounds per device call, stopping early at
-    quiescence (see overlay_ticks.make_run_fn -- same rationale and the
-    same trajectory-identity argument; round keys are (base_key, round)-
-    indexed via st.round, not call-indexed)."""
+def make_bounded_run(round_fn, quiesced_fn):
+    """Bounded phase-1 device loop: up to `max_polls` windows per call,
+    early exit at quiescence, returning (st, polls_run, quiesced) -- the
+    flag rides the loop carry so callers need no eager host-side
+    quiesced() recompute (pending_emissions reduces the full (n, cap)-
+    sized emission buffers; at large n that is an un-jitted multi-kernel
+    dispatch).  THE one harness behind overlay.make_run_fn,
+    overlay_ticks.make_run_fn and the sharded backend's fast path
+    (whose round_fn is the shard_map'd poll -- its quiescence counters
+    are psum-replicated on the outer state, so the condition is
+    mesh-uniform).  Trajectory-identical to the windowed host loop:
+    round keys are state-indexed (st.round / st.tick), not
+    call-indexed."""
     import functools
 
-    round_fn = make_round_fn(cfg)
-
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def run_fn(st: OverlayState, base_key, max_polls):
-        """Returns (st, polls_run, quiesced) -- the flag rides the loop
-        carry so callers need no eager host-side quiesced() recompute
-        (pending_emissions reduces the full (n, cap)-sized emission
-        buffers; at large n that is an un-jitted multi-kernel dispatch)."""
+    def run_fn(st, base_key, max_polls):
         def body(carry):
             st, polls, _ = carry
             st = round_fn(st, base_key)
-            return st, polls + 1, quiesced(st)
+            return st, polls + 1, quiesced_fn(st)
 
         def cond(carry):
             st, polls, q = carry
             return (polls < max_polls) & ~q
 
         return jax.lax.while_loop(
-            cond, body, (st, jnp.zeros((), I32), quiesced(st)))
+            cond, body, (st, jnp.zeros((), I32), quiesced_fn(st)))
 
     return run_fn
+
+
+def make_run_fn(cfg: Config):
+    """Bounded device-side run for the rounds engine (make_bounded_run)."""
+    return make_bounded_run(make_round_fn(cfg), quiesced)

@@ -387,14 +387,16 @@ def quiesced(st: OverlayTickState) -> jnp.ndarray:
             & ~jnp.any(st.ring_cnt > 0) & (st.tick > 0))
 
 
-def run_call_budget(cfg: Config) -> int:
+def run_call_budget(cfg: Config, shards: int = 1) -> int:
     """Poll windows per bounded overlay_run_to_quiescence device call.
     One call must stay under the device-runtime watchdog (the failure
     mode epidemic.run_call_budget documents; calibrated here 2026-07-31
     at n=1e7 on v5e: 4-window ~16 s calls get the worker killed as
     UNAVAILABLE, 2-window ~8 s calls run clean).  Target <= ~8 s/call at
-    the measured ~0.4 us/node/window."""
-    return max(1, min(1024, int(2e7 // max(cfg.n, 1))))
+    the measured ~0.4 us/node/window.  `shards` scales for a mesh
+    backend (device work tracks the per-SHARD slice), multiplying
+    BEFORE the >=1 clamp so large n keeps the ratio."""
+    return max(1, min(1024, int(2e7 * shards // max(cfg.n, 1))))
 
 
 def make_run_fn(cfg: Config):
@@ -408,24 +410,6 @@ def make_run_fn(cfg: Config):
     path: the same step/key derivation (keys are (base_key, window)-
     indexed, not call-indexed) and the same quiescence predicate on the
     same post-window states."""
-    import functools
+    from gossip_simulator_tpu.models.overlay import make_bounded_run
 
-    poll = _make_poll_body(cfg)
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def run_fn(st: OverlayTickState, base_key, max_polls):
-        """Returns (st, polls_run, quiesced) -- the flag rides the loop
-        carry so callers need no eager host-side quiesced() recompute."""
-        def body(carry):
-            st, polls, _ = carry
-            st = poll(st, base_key)
-            return st, polls + 1, quiesced(st)
-
-        def cond(carry):
-            st, polls, q = carry
-            return (polls < max_polls) & ~q
-
-        return jax.lax.while_loop(
-            cond, body, (st, jnp.zeros((), I32), quiesced(st)))
-
-    return run_fn
+    return make_bounded_run(_make_poll_body(cfg), quiesced)

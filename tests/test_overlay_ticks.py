@@ -153,17 +153,26 @@ def test_sharded_end_to_end_and_determinism():
     assert r1.stats.exchange_overflow == 0
 
 
+@pytest.mark.parametrize("backend", ["jax", "sharded"])
 @pytest.mark.parametrize("overlay_mode", ["ticks", "rounds"])
-def test_fast_path_identical_to_windowed(overlay_mode):
+def test_fast_path_identical_to_windowed(overlay_mode, backend):
     """overlay_run_to_quiescence (the quiet-run bounded device loop) must
     reproduce the windowed host loop exactly: same window count, same
     stabilization clock, same friends table, same drop counter.  Keys are
     window-indexed (not call-indexed) and the quiescence predicate runs on
     the same post-window states, so the trajectories are one and the
-    same -- this pins that."""
+    same -- this pins that, on the single-device backend AND the sharded
+    one (whose bounded loop wraps the shard_map'd poll with mesh-uniform
+    quiescence)."""
     def run(fast):
-        cfg = Config(**{**BASE, "overlay_mode": overlay_mode}).validate()
-        s = JaxStepper(cfg)
+        cfg = Config(**{**BASE, "overlay_mode": overlay_mode,
+                        "backend": backend}).validate()
+        if backend == "sharded":
+            from gossip_simulator_tpu.backends.sharded import ShardedStepper
+
+            s = ShardedStepper(cfg)
+        else:
+            s = JaxStepper(cfg)
         s.init()
         if fast:
             # Small per-call budget: forces several bounded re-entries so
