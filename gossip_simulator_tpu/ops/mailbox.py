@@ -83,8 +83,9 @@ def segment_ranks(sorted_keys: jnp.ndarray) -> jnp.ndarray:
     return idx - segstart
 
 
-def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
-            cap: int, compact_chunk: int | None = None):
+def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
+            n: int, cap: int, compact_chunk: int | None = None,
+            src_cols: int | None = None):
     """Deliver messages into per-destination mailboxes.
 
     Args:
@@ -92,6 +93,12 @@ def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
         valid: bool[M] mask of real messages.
         n: number of (local) nodes.
         cap: mailbox capacity per node.
+        src_cols: if set, `src` may be None and sender ids are DERIVED as
+            flat_index // src_cols -- for callers delivering a flattened
+            (n, src_cols) emission matrix whose sender id is the row.
+            The chunked path then skips both the caller's n*src_cols-wide
+            broadcast materialization (4*n*src_cols bytes; 720 MB at the
+            10M-node overlay) and the per-chunk gather from it.
         compact_chunk: if set (and flat int32 addressing fits,
             (n+1)*cap < 2^31 -- past that the dense 2-D path runs and this
             is silently ignored), compact the valid messages (two-level
@@ -115,10 +122,11 @@ def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
     this platform (see the NOTE in epidemic.deposit_local; the trash cell
     avoids relying on the OOB-drop semantics that were miscompiled there).
     """
-    m = src.shape[0]
+    m = dst.shape[0]
     if compact_chunk is not None and compact_chunk < m:
         if flat_addressing_fits(n, cap):
-            return _deliver_compact(src, dst, valid, n, cap, compact_chunk)
+            return _deliver_compact(src, dst, valid, n, cap, compact_chunk,
+                                    src_cols=src_cols)
         # Flat int32 addressing no longer fits: the requested compaction is
         # ignored and the full-length sort + 2-D scatter path below runs
         # (~15x slower per the NOTE).  Without a signal this reads as an
@@ -132,6 +140,8 @@ def deliver(src: jnp.ndarray, dst: jnp.ndarray, valid: jnp.ndarray, n: int,
                 "to the dense sort + 2-D scatter path (~15x slower); "
                 "reduce -mailbox-cap or shard the node axis",
                 stacklevel=2)
+    if src is None:
+        src = jnp.arange(m, dtype=jnp.int32) // src_cols
     key = jnp.where(valid, dst, n).astype(jnp.int32)
     sd, ss = jax.lax.sort((key, src.astype(jnp.int32)), num_keys=1,
                           is_stable=True)
@@ -196,13 +206,20 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
             mbox[n * cap:n2 * cap].reshape(n, cap), dropped)
 
 
-def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk):
-    """Chunked-compacted delivery on a prepacked key in [0, nk] (nk =
-    invalid sentinel) -- the ONE chunked work-horse behind both
-    _deliver_compact (key = dst) and deliver_pair (key = typ*n + dst).
-    Returns the flat (nk*cap + 1) mailbox incl. trash cell, the
-    TOTAL-arrivals count array (nk + 1), and the drop count."""
-    m = src.shape[0]
+def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
+                           src_cols=None, carry=None):
+    """Chunked-compacted delivery on a prepacked key in [0, nk) with nk
+    the invalid sentinel -- the ONE chunked work-horse behind
+    _deliver_compact (key = dst), deliver_pair (key = typ*n + dst) and
+    deliver_columns (per column, src_cols=1).  With `src_cols`, sender
+    ids derive as idx // src_cols (deliver's matrix-row contract; 1
+    makes the sender the lane index itself) instead of gathering `src`.
+    `carry`, when given, is a previous call's (mbox, count, dropped) so
+    chained calls continue per-node ranks exactly like the chunk
+    continuation within one call.  Returns the flat (nk*cap + 1) mailbox
+    incl. trash cell, the TOTAL-arrivals count array (nk + 1), and the
+    drop count."""
+    m = valid.shape[0]
     total = valid.sum(dtype=jnp.int32)
     chunks = (total + chunk - 1) // chunk
 
@@ -212,7 +229,10 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk):
         hit = jnp.zeros((m,), bool).at[idx].set(True, mode="drop")
         remaining = remaining & ~hit
         v = idx < m
-        s = src.at[idx].get(mode="fill", fill_value=-1)
+        if src_cols is None:
+            s = src.at[idx].get(mode="fill", fill_value=-1)
+        else:
+            s = jnp.where(v, idx // src_cols, -1)
         key = key_full.at[idx].get(mode="fill", fill_value=nk)
         key = jnp.where(v, key, nk)
         sd, ss = jax.lax.sort((key, s.astype(jnp.int32)), num_keys=1,
@@ -225,18 +245,50 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk):
         dropped = dropped + ((sd < nk) & (rank >= cap)).sum(dtype=jnp.int32)
         return mbox, count, dropped, remaining
 
-    mbox0 = jnp.full((nk * cap + 1,), -1, dtype=jnp.int32)
-    count0 = jnp.zeros((nk + 1,), dtype=jnp.int32)
+    if carry is None:
+        carry = (jnp.full((nk * cap + 1,), -1, dtype=jnp.int32),
+                 jnp.zeros((nk + 1,), dtype=jnp.int32),
+                 jnp.zeros((), jnp.int32))
     mbox, count, dropped, _ = jax.lax.fori_loop(
-        0, chunks, body,
-        (mbox0, count0, jnp.zeros((), jnp.int32), valid))
+        0, chunks, body, carry + (valid,))
     return mbox, count, dropped
 
 
-def _deliver_compact(src, dst, valid, n, cap, chunk):
+def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int):
+    """Per-COLUMN chunked delivery of an (n_rows, cols) emission matrix
+    whose sender id is the row index.
+
+    The flattened form scans the full n_rows*cols mask per compaction
+    chunk (~76 ms/chunk at the 10M-node overlay's 180M lanes, 84% of the
+    round); scanning per COLUMN costs n_rows lanes per chunk instead --
+    the same entries at ~1/cols the scan width -- and the sender id is
+    the chunk index itself (no src gather, no broadcast).  Arrival order
+    is therefore COLUMN-major (slot, then node): a deterministic
+    re-choice of the engine's canonical mailbox order, not a fidelity
+    change -- the reference's own arrival order is goroutine-racy
+    (simulator.go:51-54), so any fixed order is equally faithful; the
+    golden transcripts pin the one chosen here.  Per-node ranks continue
+    across columns and chunks via the total-arrivals counter, and
+    columns with zero emissions cost one n_rows-wide popcount.
+
+    Returns (mbox int32[n, cap], dropped)."""
+    cols = dst_mat.shape[1]
+    carry = None
+    for c in range(cols):
+        dcol = dst_mat[:, c]
+        # src_cols=1: the sender id is the lane index itself; the chained
+        # carry continues per-node ranks across columns exactly like the
+        # chunk continuation within one call.
+        carry = _deliver_compact_keyed(None, dcol, dcol >= 0, n, cap,
+                                       chunk, src_cols=1, carry=carry)
+    mbox, _, dropped = carry
+    return mbox[:n * cap].reshape(n, cap), dropped
+
+
+def _deliver_compact(src, dst, valid, n, cap, chunk, src_cols=None):
     """Chunked-compacted deliver (see deliver's compact_chunk)."""
     key_full = jnp.where(valid, dst, n).astype(jnp.int32)
     mbox, count, dropped = _deliver_compact_keyed(
-        src, key_full, valid, n, cap, chunk)
+        src, key_full, valid, n, cap, chunk, src_cols=src_cols)
     return (mbox[:n * cap].reshape(n, cap),
             jnp.minimum(count[:n], cap), dropped)
