@@ -70,8 +70,10 @@ def delivery_chunk(cfg: Config, n_rows: int) -> int:
     131k: 13.2s, 65k: 9.6s, 32k: 11.4s at n=1e6 -- narrow chunks win
     because per-chunk sort/scatter width dominates the extra
     first_true_indices passes of the bootstrap burst); -compact-chunk
-    overrides.  One definition for the rounds engine, the tick-faithful
-    engine and their sharded variants."""
+    overrides.  Used by the ROUNDS engine (and its sharded variant); the
+    tick-faithful engine's slot drain has its own scaling
+    (overlay_ticks.ticks_delivery_chunk -- its per-chunk cost is
+    scatter-floor-bound at GB-scale targets, favoring fat chunks)."""
     return cfg.compact_chunk if cfg.compact_chunk > 0 \
         else min(max(4096, n_rows), 65536)
 

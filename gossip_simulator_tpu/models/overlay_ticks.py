@@ -44,8 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
-from gossip_simulator_tpu.models.overlay import (delivery_chunk,
-                                                 process_breakup_slot,
+from gossip_simulator_tpu.models.overlay import (process_breakup_slot,
                                                  process_makeup_slot)
 from gossip_simulator_tpu.ops.mailbox import deliver_pair
 from gossip_simulator_tpu.ops.select import first_true_indices
@@ -90,6 +89,25 @@ def emit_chunk(cfg: Config, n_local: int | None = None) -> int:
     """Emission-compaction chunk (the drain_chunk analog)."""
     n = n_local if n_local is not None else cfg.n
     return min(slot_cap(cfg, n_local), max(4096, min(262_144, n // 8)))
+
+
+def ticks_delivery_chunk(cfg: Config, n_rows: int) -> int:
+    """Delivery chunk for THIS engine's slot drain (deliver_pair): its
+    per-chunk cost is dominated by the scatters into the stacked
+    [2n, cap] mailbox, which are ~10-20 ms FLAT per op at GB-scale
+    targets regardless of lane count (README roadmap's device-span
+    finding) -- so fewer, fatter chunks win at large n, unlike the
+    rounds engine's n-wide deliver_columns where the 64k optimum stands
+    (re-swept 2026-07-31 at 10M: 64k 3.40 s/window, 262k 2.60, 1M 2.26,
+    2M 2.18; rounds mode with 1M chunks LOSES 733 -> 1134 ms/window).
+    n/8 rounded up to a power of two (the sort pads internally), floor
+    64k (<= 512k rows keeps the swept small-n optimum), cap 2M.
+    Chunking is trajectory-neutral (rank continuation), so this is pure
+    perf; -compact-chunk overrides."""
+    if cfg.compact_chunk > 0:
+        return cfg.compact_chunk
+    want = min(max(65_536, n_rows // 8), 2_097_152)
+    return 1 << (want - 1).bit_length()
 
 
 class OverlayTickState(NamedTuple):
@@ -238,7 +256,7 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
     # (overlay_ticks_sharded uses mailbox_cap_for(n_local) too -- a mixed
     # pair would shape-mismatch the emission buffers past n ~ 1.34e8).
     cap_mb = cfg.mailbox_cap_for(n_rows)
-    dchunk = delivery_chunk(cfg, n_rows)
+    dchunk = ticks_delivery_chunk(cfg, n_rows)
     if ids_fn is None:
         ids_fn = lambda: jnp.arange(n_rows, dtype=I32)
     if key_fn is None:
