@@ -76,34 +76,66 @@ def main() -> int:
     ap.add_argument("--warmup", type=int, default=8)
     ap.add_argument("--windows", type=int, default=20)
     ap.add_argument("--out", default="/tmp/gossip_profile")
+    ap.add_argument("--phase", choices=("gossip", "overlay"),
+                    default="gossip",
+                    help="overlay: profile phase-1 construction windows "
+                         "instead (use --overlay-mode to pick the engine)")
+    ap.add_argument("--overlay-mode", choices=("rounds", "ticks"),
+                    default="rounds")
     args = ap.parse_args()
     on_tpu = jax.default_backend() == "tpu"
-    cfg = Config(n=args.n, fanout=args.fanout, graph="kout", backend="jax",
-                 seed=0, crashrate=0.001,
-                 coverage_target=args.coverage_target, max_rounds=3000,
-                 pallas=on_tpu, progress=False).validate()
-    s = JaxStepper(cfg)
-    s.init()
-    s.seed()
+    if args.phase == "overlay":
+        cfg = Config(n=args.n, graph="overlay",
+                     overlay_mode=args.overlay_mode, backend="jax",
+                     seed=0, progress=False).validate()
+        s = JaxStepper(cfg)
+        s.init()
+        # Quiescence frees the phase-1 buffers (ostate -> None) and turns
+        # further overlay_window() calls into host no-ops that would skew
+        # ms/window -- step with a live guard and report actual windows.
+        step = lambda: s.ostate is not None and not s.overlay_window()[2]
+        ready = lambda: jax.block_until_ready(
+            s.ostate.friends if s.ostate is not None else s.state.friends)
+        label = f"phase=overlay/{args.overlay_mode}"
+    else:
+        cfg = Config(n=args.n, fanout=args.fanout, graph="kout",
+                     backend="jax", seed=0, crashrate=0.001,
+                     coverage_target=args.coverage_target, max_rounds=3000,
+                     pallas=on_tpu, progress=False).validate()
+        s = JaxStepper(cfg)
+        s.init()
+        s.seed()
+        step = lambda: bool(s.gossip_window()) or True
+        ready = lambda: jax.block_until_ready(s.state.flags)
+        label = "phase=gossip"
+
     # Steady state: run past the early near-empty windows.
     for _ in range(args.warmup):
-        s.gossip_window()
-    jax.block_until_ready(s.state.flags)
+        if not step():
+            print("quiesced during warmup -- lower --warmup/--n")
+            return 1
+    ready()
+    ran = 0
     t0 = time.perf_counter()
     with jax.profiler.trace(args.out):
         for _ in range(args.windows):
-            s.gossip_window()
-        jax.block_until_ready(s.state.flags)
+            if not step():
+                break
+            ran += 1
+        ready()
     wall = time.perf_counter() - t0
+    if ran == 0:
+        print("no live windows profiled -- lower --warmup")
+        return 1
     rows, loop_total = parse_trace(args.out)
-    print(f"device={jax.devices()[0].device_kind} n={cfg.n} "
-          f"windows={args.windows} wall={wall:.2f}s "
-          f"({wall / args.windows * 1e3:.1f} ms/window, device "
-          f"{loop_total / args.windows:.1f} ms/window)")
+    print(f"device={jax.devices()[0].device_kind} n={cfg.n} {label} "
+          f"windows={ran} wall={wall:.2f}s "
+          f"({wall / ran * 1e3:.1f} ms/window, device "
+          f"{loop_total / ran:.1f} ms/window)")
     print(f"{'op':44s} {'ms_total':>9s} {'ms/win':>8s} {'count':>6s} "
           f"{'%loop':>5s}")
     for nm, ms, c in rows:
-        print(f"{nm[:44]:44s} {ms:9.1f} {ms / args.windows:8.2f} {c:6d} "
+        print(f"{nm[:44]:44s} {ms:9.1f} {ms / ran:8.2f} {c:6d} "
               f"{100 * ms / loop_total:5.1f}")
     return 0
 
