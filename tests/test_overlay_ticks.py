@@ -197,3 +197,32 @@ def test_fast_path_identical_to_windowed(overlay_mode, backend):
     assert df == dw
     np.testing.assert_array_equal(ff, fw)
     np.testing.assert_array_equal(cf, cw)
+
+
+def test_phase1_sizing_functions():
+    """Pin the watchdog budgets and delivery-chunk scaling rules (swept
+    on v5e 2026-07-31; drifts here silently change device-call duration
+    -- the >10s watchdog kills workers -- or per-window chunk counts)."""
+    from gossip_simulator_tpu.models import overlay, overlay_ticks
+
+    # Watchdog budgets: <= ~8s/call; shards scale BEFORE the >=1 clamp.
+    assert overlay_ticks.run_call_budget(Config(n=10_000_000)) == 2
+    assert overlay_ticks.run_call_budget(Config(n=1_000_000)) == 20
+    assert overlay_ticks.run_call_budget(Config(n=100_000_000),
+                                         shards=8) == 1
+    assert overlay_ticks.run_call_budget(Config(n=10_000_000),
+                                         shards=8) == 16
+    assert overlay.run_call_budget(Config(n=1_000_000)) == 40
+    assert overlay.run_call_budget(Config(n=2000)) == 1024  # clamp hi
+    # Ticks delivery chunk: n/8 pow2-rounded in [64k, 2M]; explicit
+    # -compact-chunk overrides.
+    tdc = overlay_ticks.ticks_delivery_chunk
+    assert tdc(Config(n=500_000), 500_000) == 65_536
+    assert tdc(Config(n=1_000_000), 1_000_000) == 131_072
+    assert tdc(Config(n=10_000_000), 10_000_000) == 2_097_152
+    assert tdc(Config(n=100_000_000), 100_000_000) == 2_097_152
+    assert tdc(Config(n=10_000_000, compact_chunk=65_536),
+               10_000_000) == 65_536
+    # Rounds delivery chunk unchanged at its swept 64k optimum.
+    assert overlay.delivery_chunk(Config(n=10_000_000),
+                                  10_000_000) == 65_536
