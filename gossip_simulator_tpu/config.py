@@ -211,11 +211,19 @@ class Config:
             return "event"
         return "ring"
 
-    def mailbox_cap_for(self, n_rows: int) -> int:
+    def mailbox_cap_for(self, n_rows: int, *, stacked: bool = False) -> int:
         """Mailbox capacity for a delivery surface of `n_rows` local rows
         (the full node axis single-device; one shard's slice sharded --
         flat int32 addressing is per-LOCAL-array, so a sharded run keeps
-        cap 16 well past the single-device boundary)."""
+        cap 16 well past the single-device boundary).
+
+        `stacked=True` is for consumers that deliver through
+        ops.mailbox.deliver_pair's stacked [2n, cap] flat addressing (the
+        phase-1 ticks engines); only they shrink at the half boundary.
+        Plain deliver() surfaces -- the rounds overlay and the phase-2
+        ring delivery, in any overlay mode -- keep the full-boundary cap
+        (advisor r3: a mode-keyed shrink halved phase-2 overflow headroom
+        in ticks runs for n_local in (~6.7e7, 1.34e8] for no reason)."""
         if self.mailbox_cap > 0:
             return self.mailbox_cap
         # Balls-in-bins: with <=N uniform messages into N bins the max load is
@@ -237,7 +245,7 @@ class Config:
         # EXACTLY the gates the delivery paths consult (deliver_pair
         # checks fits(2n+1, cap); deliver checks fits(n, cap)) so the two
         # bounds can never drift by an off-by-one.
-        rows = 2 * n_rows + 1 if self.overlay_mode == "ticks" else n_rows
+        rows = 2 * n_rows + 1 if stacked else n_rows
         if not flat_addressing_fits(rows, 16):
             return 8
         return 16

@@ -45,6 +45,15 @@ from gossip_simulator_tpu.utils import rng as _rng
 
 I32 = jnp.int32
 
+# Above this row count the rounds engine delivers per COLUMN
+# (ops.mailbox.deliver_columns, column-major arrival order) instead of the
+# flattened row-major path; below it the per-column machinery is
+# op-floor-bound (measured 4x slower at 1M -- make_round_fn's rationale).
+# Module-level so a CPU test can lower it and pin the column-major
+# trajectory band with a small-n golden (advisor r3: the band was
+# otherwise exercisable only by on-TPU runs).
+COLUMN_DELIVERY_MIN_ROWS = 4_000_000
+
 
 def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     n = n_local if n_local is not None else cfg.n
@@ -187,7 +196,7 @@ def make_round_fn(cfg: Config,
         from gossip_simulator_tpu.ops.mailbox import (deliver_columns,
                                                       flat_addressing_fits)
 
-        if n > 4_000_000 and flat_addressing_fits(n, cap):
+        if n > COLUMN_DELIVERY_MIN_ROWS and flat_addressing_fits(n, cap):
             # Per-COLUMN delivery: same entries at ~1/cols the compaction
             # scan width (deliver_columns' rationale; the flattened form
             # was 84% of the round at 10M nodes: 42.5 -> 25.3 s there).

@@ -253,9 +253,10 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
     dw = ring_windows(cfg)
     cap = slot_cap(cfg, n_local)
     # Per-LOCAL-rows cap, matching the sharded caller's emit_routed
-    # (overlay_ticks_sharded uses mailbox_cap_for(n_local) too -- a mixed
-    # pair would shape-mismatch the emission buffers past n ~ 1.34e8).
-    cap_mb = cfg.mailbox_cap_for(n_rows)
+    # (overlay_ticks_sharded uses the same stacked cap -- a mixed pair
+    # would shape-mismatch the emission buffers past n ~ 1.34e8).
+    # stacked=True: delivery here is deliver_pair's [2n, cap] addressing.
+    cap_mb = cfg.mailbox_cap_for(n_rows, stacked=True)
     dchunk = ticks_delivery_chunk(cfg, n_rows)
     if ids_fn is None:
         ids_fn = lambda: jnp.arange(n_rows, dtype=I32)

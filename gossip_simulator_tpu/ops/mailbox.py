@@ -30,11 +30,12 @@ _warned_dense_fallback = False
 def flat_addressing_fits(n: int, cap: int) -> bool:
     """True iff the [n, cap] mailbox can use flat int32 addressing (the fast
     sort + 1-D-scatter delivery paths; index n*cap is the trash cell).  The
-    auto mailbox cap (Config.mailbox_cap_resolved) shrinks 16 -> 8 right
-    where its engine's gate stops fitting -- past n ~ 1.34e8 in rounds
-    mode (single [n, cap] arrays, flat to n ~ 2.7e8 at cap 8), past
-    n ~ 6.7e7 in ticks mode (deliver_pair's stacked [2n, cap] buffer,
-    one-pass to n ~ 1.34e8 at cap 8)."""
+    auto mailbox cap (Config.mailbox_cap_for) shrinks 16 -> 8 right where
+    its CONSUMER's gate stops fitting -- past n ~ 1.34e8 for plain
+    deliver() surfaces (single [n, cap] arrays, flat to n ~ 2.7e8 at
+    cap 8), past n ~ 6.7e7 for stacked=True consumers (the ticks
+    overlay's deliver_pair [2n, cap] buffer, one-pass to n ~ 1.34e8 at
+    cap 8)."""
     return (n + 1) * cap < 2**31
 
 
@@ -123,6 +124,11 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
     avoids relying on the OOB-drop semantics that were miscompiled there).
     """
     m = dst.shape[0]
+    if src is None and src_cols is None:
+        # Caught here rather than as `int // None` in the derivation below
+        # (advisor r3: the non-compact path otherwise raised an opaque
+        # TypeError).
+        raise ValueError("deliver: src=None requires src_cols")
     if compact_chunk is not None and compact_chunk < m:
         if flat_addressing_fits(n, cap):
             return _deliver_compact(src, dst, valid, n, cap, compact_chunk,

@@ -137,7 +137,7 @@ def make_poll_fn(cfg: Config, mesh):
     n_local = shard_size(cfg.n, mesh)
     b = ot.batch_ticks(cfg)
     dw = ot.ring_windows(cfg)
-    cap_mb = cfg.mailbox_cap_for(n_local)
+    cap_mb = cfg.mailbox_cap_for(n_local, stacked=True)
     echunk = ot.emit_chunk(cfg, n_local)
     rcap = exchange.epidemic_cap(echunk, 1, s)
     steps = max(1, -(-10 // b))

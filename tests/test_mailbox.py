@@ -136,20 +136,25 @@ def test_deliver_pair_matches_two_delivers():
             assert int(d0) + int(d1) == int(dp)
 
 
-def test_auto_mailbox_cap_decliff_ticks_mode():
-    """Ticks mode shrinks the auto cap at HALF the rounds-mode boundary
-    (~6.7e7): deliver_pair's stacked [2n, cap] flat addressing must fit,
-    keeping the fused one-pass delivery to the 100M flagship scale."""
+def test_auto_mailbox_cap_decliff_stacked():
+    """Stacked consumers (the ticks overlay's deliver_pair [2n, cap]
+    addressing) shrink the auto cap at HALF the plain boundary (~6.7e7);
+    plain deliver() surfaces -- incl. phase-2 delivery in a ticks-mode
+    run -- keep the full-boundary cap (advisor r3: the shrink is keyed on
+    the consumer, not on overlay_mode)."""
     from gossip_simulator_tpu.config import Config
     from gossip_simulator_tpu.ops.mailbox import flat_addressing_fits
 
-    def cap(n, mode):
-        return Config(n=n, overlay_mode=mode).mailbox_cap_resolved
+    def cap(n, mode, stacked):
+        return Config(n=n, overlay_mode=mode).mailbox_cap_for(
+            n, stacked=stacked)
 
-    assert cap(67_000_000, "ticks") == 16
-    assert cap(68_000_000, "ticks") == 8        # stacked 16 would overflow
-    assert cap(68_000_000, "rounds") == 16      # rounds keeps single-array
-    assert cap(134_000_000, "ticks") == 8
+    assert cap(67_000_000, "ticks", True) == 16
+    assert cap(68_000_000, "ticks", True) == 8  # stacked 16 would overflow
+    assert cap(68_000_000, "rounds", False) == 16
+    # Phase-2 delivery in a ticks run is a PLAIN surface: no early shrink.
+    assert cap(68_000_000, "ticks", False) == 16
+    assert cap(134_000_000, "ticks", True) == 8
     # The shrunk cap keeps the STACKED addressing flat to ~1.34e8.
     assert flat_addressing_fits(2 * 134_000_000 + 1, 8)
 
@@ -200,3 +205,29 @@ def test_deliver_derived_src_matches_explicit():
                       src_cols=cols)
         for a, b in zip(ref, got):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_column_delivery_band_small_n_golden(monkeypatch):
+    """Pin the column-major trajectory band of the rounds overlay engine.
+
+    Above overlay.COLUMN_DELIVERY_MIN_ROWS (4M in production) delivery
+    switches to deliver_columns and the canonical mailbox arrival order
+    becomes column-major -- a band CI could otherwise never execute
+    (advisor r3: the threshold was hard-coded).  Lowering the module
+    constant routes a 3000-node build through the exact large-n code path;
+    the pinned totals are the column-major trajectory (the row-major path
+    gives total_message=10176 at this seed -- the band genuinely differs)."""
+    import gossip_simulator_tpu.models.overlay as ov
+    from gossip_simulator_tpu.config import Config
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    monkeypatch.setattr(ov, "COLUMN_DELIVERY_MIN_ROWS", 0)
+    cfg = Config(n=3000, graph="overlay", fanout=5, seed=9, backend="jax",
+                 progress=False, coverage_target=0.9).validate()
+    res = run_simulation(cfg, printer=ProgressPrinter(False))
+    assert res.stabilize_ms == 240.0
+    assert res.stats.total_received == 2960
+    assert res.stats.total_message == 10160
+    assert res.stats.total_crashed == 14
+    assert res.stats.mailbox_dropped == 0
