@@ -39,24 +39,27 @@ from gossip_simulator_tpu.backends.native import NativeStepper  # noqa: E402
 from gossip_simulator_tpu.config import Config  # noqa: E402
 
 
-def _bench_jax(cfg: Config) -> dict:
-    """Time the device-side run-to-99% while_loop (excludes compile; the
-    graph build is timed separately, split into first-call -- tracing +
-    compile + generate -- and steady-state regeneration with the executable
-    cached, so the headline isn't misread as generation-bound when the cost
-    is one-off compilation)."""
+def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
+    """Time the device-side run-to-target while_loop for any Stepper
+    backend (excludes compile).  THE one warmup/reset/timed protocol --
+    the sharded-vs-jax 1-chip twins the README projection rests on must
+    stay like-for-like, so both go through here.
+
+    With `time_graph_gen`, steady-state graph generation is timed
+    separately (first-call init is tracing + compile + generate; the
+    regeneration shows the cached-executable cost) -- skipped at
+    100M-scale where it would hold a SECOND friends table (2.4 GB at
+    1e8 x 6) alongside the live state; transient peaks like that are
+    what crashed the r2 fanout-6 attempts on the 16 GB v5e."""
+    from gossip_simulator_tpu.backends import make_stepper
     from gossip_simulator_tpu.models import graphs
 
-    s = JaxStepper(cfg)
+    s = make_stepper(cfg)
     t0 = time.perf_counter()
     s.init()
     jax.block_until_ready(s.state.friends)
     graph_s = time.perf_counter() - t0
-    if cfg.n < 50_000_000:
-        # Steady-state generation: same executable, fresh run.  Skipped at
-        # 100M-scale: it would hold a SECOND friends table (2.4 GB at 1e8 x
-        # 6) alongside the live state -- transient peaks like this are what
-        # crashed the r2 fanout-6 attempts on the 16 GB v5e.
+    if time_graph_gen and cfg.n < 50_000_000:
         t0 = time.perf_counter()
         f, c = graphs.generate(cfg, graphs.graph_key(cfg))
         jax.block_until_ready(f)
@@ -75,12 +78,18 @@ def _bench_jax(cfg: Config) -> dict:
     run_s = time.perf_counter() - t0
     ticks = stats.round
     return {
-        "n": cfg.n, "ticks": ticks, "run_s": run_s, "graph_s": graph_s,
-        "graph_gen_s": graph_gen_s,
+        "n": cfg.n, "backend": cfg.backend, "ticks": ticks, "run_s": run_s,
+        "graph_s": graph_s, "graph_gen_s": graph_gen_s,
         "coverage": stats.coverage, "total_message": stats.total_message,
+        "ns_per_message": (run_s * 1e9 / stats.total_message
+                           if stats.total_message else None),
         "node_updates_per_sec": cfg.n * ticks / run_s if run_s > 0 else 0.0,
         "converged": stats.coverage >= cfg.coverage_target,
     }
+
+
+def _bench_jax(cfg: Config) -> dict:
+    return _bench_backend(cfg, time_graph_gen=True)
 
 
 def _bench_oracle(cfg: Config, budget_s: float = 20.0) -> dict:
@@ -168,6 +177,33 @@ def headline(n: int | None, seed: int) -> dict:
         "vs_cpp_event_loop": round(vs_cpp, 2),
         "detail": detail,
     }
+
+
+def capture_sharded_1chip(detail: dict, seed: int) -> None:
+    """VERDICT r3 #1: the sharded engine's real-TPU cost at equal n vs the
+    jax backend -- measures the routing constant (route_multi sort+scatter,
+    bucket compaction, pmax-agreed batch counts; all_to_all is degenerate
+    at S=1) that the v5e-8 projection assumes.  Round-4 measurement:
+    10M fanout 3 sharded 2.394s vs jax 2.259s (+6%); 50M fanout 6 @99%
+    sharded 21.44s (86.1 ns/msg) vs jax 19.40s (75.3 ns/msg) -- +10.5%
+    wall, ~+11 ns/entry.  100M on ONE device exceeds the sharded wire
+    packing bound (n_local*dw*B < 2^31 -- a per-SHARD bound: v5e-8's
+    n_local=12.5M is 30x inside it), so 50M is the largest 1-chip twin."""
+    base = Config(n=10_000_000, fanout=3, graph="kout", backend="sharded",
+                  seed=seed, crashrate=0.001, coverage_target=0.90,
+                  max_rounds=3000, pallas=True, progress=False).validate()
+    for name, cfg in (
+        ("sharded_10m", base),
+        ("sharded_50m_99pct", base.replace(
+            n=50_000_000, fanout=6, coverage_target=0.99).validate()),
+        ("jax_50m_99pct", base.replace(
+            n=50_000_000, fanout=6, coverage_target=0.99,
+            backend="jax").validate()),
+    ):
+        try:
+            detail[name] = _bench_backend(cfg)
+        except Exception as e:  # record, don't kill the record
+            detail[name] = {"error": repr(e)}
 
 
 def capture_100m(detail: dict, seed: int, headline_n: int) -> None:
@@ -338,6 +374,11 @@ def main() -> int:
 
             here = os.path.dirname(os.path.abspath(__file__))
             partial = os.path.join(here, "BENCH_PARTIAL.json")
+            with open(partial, "w") as fh:
+                json.dump(result, fh)
+            capture_sharded_1chip(result["detail"], args.seed)
+            # Refresh the salvage so a worker fault in the near-ceiling
+            # 100M rows can't discard the just-measured sharded twins.
             with open(partial, "w") as fh:
                 json.dump(result, fh)
             capture_100m(result["detail"], args.seed,
