@@ -78,7 +78,8 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
     run_s = time.perf_counter() - t0
     ticks = stats.round
     return {
-        "n": cfg.n, "backend": cfg.backend, "ticks": ticks, "run_s": run_s,
+        "n": cfg.n, "backend": cfg.backend, "devices": jax.device_count(),
+        "ticks": ticks, "run_s": run_s,
         "graph_s": graph_s, "graph_gen_s": graph_gen_s,
         "coverage": stats.coverage, "total_message": stats.total_message,
         "ns_per_message": (run_s * 1e9 / stats.total_message
@@ -188,7 +189,10 @@ def capture_sharded_1chip(detail: dict, seed: int) -> None:
     sharded 21.44s (86.1 ns/msg) vs jax 19.40s (75.3 ns/msg) -- +10.5%
     wall, ~+11 ns/entry.  100M on ONE device exceeds the sharded wire
     packing bound (n_local*dw*B < 2^31 -- a per-SHARD bound: v5e-8's
-    n_local=12.5M is 30x inside it), so 50M is the largest 1-chip twin."""
+    n_local=12.5M is 30x inside it), so 50M is the largest 1-chip twin.
+    The rows record `devices`: on a multi-chip host the sharded rows are
+    a real S-way run (ICI included), not the S=1 routing-constant twin --
+    read them accordingly."""
     base = Config(n=10_000_000, fanout=3, graph="kout", backend="sharded",
                   seed=seed, crashrate=0.001, coverage_target=0.90,
                   max_rounds=3000, pallas=True, progress=False).validate()

@@ -78,7 +78,7 @@ class ShardedStepper(Stepper):
             self.state = None
             self._overlay_done = True
         elif cfg.graph == "overlay":
-            self._faithful_overlay = cfg.overlay_mode == "ticks"
+            self._faithful_overlay = cfg.overlay_mode_resolved == "ticks"
             if self._faithful_overlay:
                 from gossip_simulator_tpu.parallel import \
                     overlay_ticks_sharded as ots

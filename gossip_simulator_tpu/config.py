@@ -38,6 +38,10 @@ PROTOCOLS = ("si", "pushpull", "sir")
 GRAPHS = ("overlay", "kout", "erdos", "ring")
 TIME_MODES = ("ticks", "rounds")
 ENGINES = ("auto", "ring", "event")
+# overlay_mode="auto" picks the tick-faithful phase-1 engine up to this n
+# (measured: ticks costs ~0.5s at 100k, ~11s at 1M, 3-4x rounds mode
+# above -- README "Overlay mode at scale").
+OVERLAY_TICKS_AUTO_MAX = 1_000_000
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,9 +114,15 @@ class Config:
     # "ticks" keeps the reference's per-message uniform delays through a
     # packed window-slot ring (models/overlay_ticks.py, sharded variant
     # parallel/overlay_ticks_sharded.py) so the stabilization clock is
-    # true simulated ms (simulator.go:151-168).  native/cpp are inherently
-    # faithful (discrete-event) and ignore the flag.
-    overlay_mode: str = "rounds"
+    # true simulated ms (simulator.go:151-168).  "auto" (default)
+    # size-bands: ticks at n <= 1e6 -- the reference's default n=50000
+    # lands there and the faithful engine costs little at that scale --
+    # rounds above, where ticks costs 3-4x more and the estimated clock
+    # measured within ~1 window of true (r3: 380 true vs 390 estimated ms
+    # at 1M, 400 vs 405 at 10M); a one-line notice marks the estimate.
+    # native/cpp are inherently faithful (discrete-event) and ignore the
+    # flag.
+    overlay_mode: str = "auto"
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
@@ -186,6 +196,18 @@ class Config:
         """Push-pull anti-entropy is a synchronous per-round protocol; it always
         runs (and is budgeted) in rounds mode regardless of `time_mode`."""
         return "rounds" if self.protocol == "pushpull" else self.time_mode
+
+    @property
+    def overlay_mode_resolved(self) -> str:
+        """Size-banded 'auto' resolution (see the field comment): ticks at
+        n <= OVERLAY_TICKS_AUTO_MAX on tick-semantics runs, rounds
+        otherwise (the ticks overlay engine needs -time-mode ticks)."""
+        if self.overlay_mode != "auto":
+            return self.overlay_mode
+        if (self.backend in ("jax", "sharded")
+                and self.effective_time_mode != "ticks"):
+            return "rounds"
+        return "ticks" if self.n <= OVERLAY_TICKS_AUTO_MAX else "rounds"
 
     @property
     def compact_resolved(self) -> bool:
@@ -314,13 +336,15 @@ class Config:
             raise ValueError(
                 f"time_mode must be one of {TIME_MODES}, got {self.time_mode!r}"
             )
-        if self.overlay_mode not in ("rounds", "ticks"):
+        if self.overlay_mode not in ("auto", "rounds", "ticks"):
             raise ValueError(
-                f"overlay_mode must be 'rounds' or 'ticks', "
+                f"overlay_mode must be 'auto', 'rounds' or 'ticks', "
                 f"got {self.overlay_mode!r}")
         if self.overlay_mode == "ticks" and self.graph == "overlay":
             # native/cpp are discrete-event and inherently faithful, so the
             # flag is a no-op there; only the vectorized backends gate.
+            # (auto resolves to rounds on rounds-semantics runs instead of
+            # erroring -- the gate is for the EXPLICIT request.)
             if (self.backend in ("jax", "sharded")
                     and self.effective_time_mode != "ticks"):
                 raise ValueError(
@@ -427,7 +451,8 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-event-chunk", "--event-chunk", dest="event_chunk",
                    type=int, default=d.event_chunk)
     p.add_argument("-overlay-mode", "--overlay-mode", dest="overlay_mode",
-                   choices=("rounds", "ticks"), default=d.overlay_mode)
+                   choices=("auto", "rounds", "ticks"),
+                   default=d.overlay_mode)
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

@@ -73,6 +73,18 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     overlay_windows = 0
     if not resumed:
         printer.section("Constructing Overlay")
+        if (cfg.graph == "overlay" and cfg.overlay_mode == "auto"
+                and cfg.backend in ("jax", "sharded")
+                and cfg.effective_time_mode == "ticks"
+                and cfg.overlay_mode_resolved == "rounds"):
+            # The size-banded default (config.OVERLAY_TICKS_AUTO_MAX) uses
+            # the estimated clock above 1M nodes; say so once.  Gated on
+            # tick semantics: when -time-mode rounds forced the rounds
+            # overlay, recommending -overlay-mode ticks would point at a
+            # config validate() rejects.
+            printer.note("overlay clock estimated as rounds x mean delay "
+                         "at this n; -overlay-mode ticks gives per-message-"
+                         "faithful timing at 3-4x the cost")
         max_overlay_windows = max(cfg.max_rounds, 1000)
         # Same observability gate as the phase-2 fast path below: a quiet
         # run has no per-window output, so stabilization can run as bounded

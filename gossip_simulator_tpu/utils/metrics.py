@@ -125,6 +125,11 @@ class ProgressPrinter:
             f"Total message {stats.total_message} Total Crashed {stats.total_crashed}",
             event="totals", **stats.to_dict())
 
+    def note(self, text: str):
+        """One-line informational notice (progress-only: quiet runs and
+        non-primary ranks skip it; it never reaches the totals surface)."""
+        self._emit(f"({text})", progress_only=True, event="note", text=text)
+
     def section(self, title: str):
         self._emit(f"\n=== {title} ===", event="section", title=title)
 

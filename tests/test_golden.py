@@ -55,8 +55,10 @@ def test_overlay_ticks_byte_exact():
 def test_sharded_overlay_byte_exact():
     """Multi-chip output surface on the 8-fake-device CPU mesh: replicated
     psum'd totals printed once (single printer), per-window membership
-    counts from the sharded overlay engine, estimated rounds-mode
-    stabilization clock, and the final totals line.  Regenerate with:
+    counts from the sharded overlay engine, and the final totals line.
+    n=2000 <= OVERLAY_TICKS_AUTO_MAX, so the auto default resolves to the
+    tick-faithful engine and the stabilization clock is true simulated ms
+    (round 4's size-banded default).  Regenerate with:
     PALLAS_AXON_POOL_IPS="" JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m gossip_simulator_tpu -n 2000 -backend sharded -graph overlay \

@@ -223,8 +223,12 @@ def test_column_delivery_band_small_n_golden(monkeypatch):
     from gossip_simulator_tpu.utils.metrics import ProgressPrinter
 
     monkeypatch.setattr(ov, "COLUMN_DELIVERY_MIN_ROWS", 0)
-    cfg = Config(n=3000, graph="overlay", fanout=5, seed=9, backend="jax",
-                 progress=False, coverage_target=0.9).validate()
+    # overlay_mode="rounds" explicitly: deliver_columns is the ROUNDS
+    # engine's large-n path, and the auto default resolves to ticks at
+    # this n (size-banded default, round 4).
+    cfg = Config(n=3000, graph="overlay", overlay_mode="rounds", fanout=5,
+                 seed=9, backend="jax", progress=False,
+                 coverage_target=0.9).validate()
     res = run_simulation(cfg, printer=ProgressPrinter(False))
     assert res.stabilize_ms == 240.0
     assert res.stats.total_received == 2960
