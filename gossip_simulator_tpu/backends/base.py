@@ -82,6 +82,17 @@ class Stepper(abc.ABC):
     def load_state_pytree(self, tree) -> None:
         raise NotImplementedError(f"{self.name} does not support checkpoint restore")
 
+    def overlay_state_pytree(self):
+        """Mid-construction phase-1 state for checkpointing; None if
+        unsupported (the discrete-event oracles run phase 1 in seconds at
+        their feasible n).  Collective under -distributed, like
+        state_pytree."""
+        return None
+
+    def load_overlay_state_pytree(self, tree, windows: int = 0) -> None:
+        raise NotImplementedError(
+            f"{self.name} does not support phase-1 checkpoint restore")
+
 
 def run_bounded_to_target(stepper) -> Stats:
     """Shared host loop for the JAX backends' run_to_target fast path.

@@ -40,20 +40,7 @@ class JaxStepper(Stepper):
             self.state = None
             self._overlay_done = True
         elif cfg.graph == "overlay":
-            self._faithful_overlay = cfg.overlay_mode_resolved == "ticks"
-            if self._faithful_overlay:
-                from gossip_simulator_tpu.models import overlay_ticks
-
-                self._omod = overlay_ticks
-                self._oround = overlay_ticks.make_poll_fn(cfg)
-                self.ostate = overlay_ticks.init_state(cfg, self.key)
-            else:
-                self._omod = overlay
-                self._oround = jax.jit(overlay.make_round_fn(cfg))
-                self.ostate = overlay.init_state(cfg)
-            self._overlay_done = False
-            self._orun = None  # lazy: compiled only on the fast path
-            self.state = None
+            self._setup_overlay(build_state=True)
         else:
             friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
             self.state = self._engine.init_state(cfg, friends, cnt)
@@ -65,6 +52,28 @@ class JaxStepper(Stepper):
         self._mailbox_dropped = 0
 
     # --- phase 1 ---------------------------------------------------------------
+    def _setup_overlay(self, build_state: bool) -> None:
+        """Overlay-engine machinery (round fn, module, optional initial
+        state).  `build_state=False` is the phase-1 RESUME path: the
+        restored snapshot replaces the initial state, so building the
+        bootstrap burst here would be thrown away."""
+        cfg = self.cfg
+        self._faithful_overlay = cfg.overlay_mode_resolved == "ticks"
+        if self._faithful_overlay:
+            from gossip_simulator_tpu.models import overlay_ticks
+
+            self._omod = overlay_ticks
+            self._oround = overlay_ticks.make_poll_fn(cfg)
+            self.ostate = (overlay_ticks.init_state(cfg, self.key)
+                           if build_state else None)
+        else:
+            self._omod = overlay
+            self._oround = jax.jit(overlay.make_round_fn(cfg))
+            self.ostate = overlay.init_state(cfg) if build_state else None
+        self._overlay_done = False
+        self._orun = None  # lazy: compiled only on the fast path
+        self.state = None
+
     def overlay_window(self) -> tuple[int, int, bool]:
         if self._overlay_done:
             return 0, 0, True
@@ -198,6 +207,35 @@ class JaxStepper(Stepper):
         return float(jax.device_get(self.state.tick))
 
     # --- checkpoint ------------------------------------------------------------
+    def overlay_state_pytree(self):
+        """Mid-construction phase-1 snapshot (None once the overlay is
+        done -- phase-2 snapshots take over then)."""
+        if self._overlay_done or self.ostate is None:
+            return None
+        return {k: np.asarray(v) for k, v in self.ostate._asdict().items()}
+
+    def load_overlay_state_pytree(self, tree, windows: int = 0) -> None:
+        """Resume INTO phase 1: validate the overlay snapshot
+        (utils/checkpoint.prepare_overlay_restore_tree), rebuild the
+        engine machinery without the bootstrap burst, and continue
+        construction from the restored state.  `windows` is the snapshot's
+        overlay-window count (drives the rounds engine's estimated
+        clock; the ticks engine's clock rides the restored tick)."""
+        from gossip_simulator_tpu.utils.checkpoint import \
+            prepare_overlay_restore_tree
+
+        cfg = self.cfg
+        tree = prepare_overlay_restore_tree(tree, cfg, n_shards=1)
+        self._setup_overlay(build_state=False)
+        cls = (self._omod.OverlayTickState if self._faithful_overlay
+               else self._omod.OverlayState)
+        self.ostate = cls(**{k: jax.numpy.asarray(v)
+                             for k, v in tree.items()})
+        self._overlay_rounds = int(windows)
+        self._phase1_ms = (
+            float(np.asarray(tree["tick"])) if self._faithful_overlay
+            else self._overlay_rounds * self._mean_delay)
+
     def state_pytree(self):
         if self.state is None:
             return None

@@ -78,26 +78,33 @@ class ShardedStepper(Stepper):
             self.state = None
             self._overlay_done = True
         elif cfg.graph == "overlay":
-            self._faithful_overlay = cfg.overlay_mode_resolved == "ticks"
-            if self._faithful_overlay:
-                from gossip_simulator_tpu.parallel import \
-                    overlay_ticks_sharded as ots
-
-                self._oround = ots.make_poll_fn(cfg, self.mesh)
-                self.ostate = ots.make_sharded_init(cfg, self.mesh)(self.key)
-            else:
-                self._oround = sharded_step.make_overlay_round_fn(
-                    cfg, self.mesh)
-                self.ostate = sharded_step.make_sharded_overlay_init(
-                    cfg, self.mesh)()
-            self._overlay_done = False
-            self.state = None
+            self._setup_overlay(build_state=True)
         else:
             self._init_fn = init_fn(cfg, self.mesh)
             self.state = self._init_fn()
             self._overlay_done = True
 
     # --- phase 1 ---------------------------------------------------------------
+    def _setup_overlay(self, build_state: bool) -> None:
+        """Overlay machinery over the mesh; `build_state=False` is the
+        phase-1 resume path (see JaxStepper._setup_overlay)."""
+        cfg = self.cfg
+        self._faithful_overlay = cfg.overlay_mode_resolved == "ticks"
+        if self._faithful_overlay:
+            from gossip_simulator_tpu.parallel import \
+                overlay_ticks_sharded as ots
+
+            self._oround = ots.make_poll_fn(cfg, self.mesh)
+            self.ostate = (ots.make_sharded_init(cfg, self.mesh)(self.key)
+                           if build_state else None)
+        else:
+            self._oround = sharded_step.make_overlay_round_fn(
+                cfg, self.mesh)
+            self.ostate = (sharded_step.make_sharded_overlay_init(
+                cfg, self.mesh)() if build_state else None)
+        self._overlay_done = False
+        self.state = None
+
     def _overlay_mod(self):
         if getattr(self, "_faithful_overlay", False):
             from gossip_simulator_tpu.models import overlay_ticks
@@ -247,6 +254,46 @@ class ShardedStepper(Stepper):
             # Between quiescence and the broadcast: phase-1 elapsed time.
             return getattr(self, "_stabilize_ms", 0.0)
         return float(jax.device_get(self.state.tick))
+
+    def overlay_state_pytree(self):
+        """Host-gathered mid-construction phase-1 snapshot (None once the
+        overlay is done).  Sharded leaves gather to global arrays; the
+        ticks engine's packed ring gathers as S per-shard rings
+        concatenated (spec P(AXIS)), so it restores onto the same shard
+        count only -- prepare_overlay_restore_tree checks the geometry."""
+        if self._overlay_done or self.ostate is None:
+            return None
+        return {k: _host_gather(v) for k, v in self.ostate._asdict().items()}
+
+    def load_overlay_state_pytree(self, tree, windows: int = 0) -> None:
+        """Resume INTO phase 1 on the mesh (see JaxStepper's method)."""
+        from jax.sharding import NamedSharding
+
+        from gossip_simulator_tpu.utils.checkpoint import \
+            prepare_overlay_restore_tree
+
+        cfg, mesh = self.cfg, self.mesh
+        tree = prepare_overlay_restore_tree(tree, cfg,
+                                            n_shards=mesh.shape[AXIS])
+        self._setup_overlay(build_state=False)
+        if self._faithful_overlay:
+            from gossip_simulator_tpu.models.overlay_ticks import \
+                OverlayTickState
+            from gossip_simulator_tpu.parallel.overlay_ticks_sharded import \
+                overlay_tick_state_specs
+
+            cls, specs = OverlayTickState, overlay_tick_state_specs()
+        else:
+            from gossip_simulator_tpu.models.state import OverlayState
+
+            cls, specs = OverlayState, sharded_step.overlay_state_specs()
+        self.ostate = cls(**{
+            k: jax.device_put(v, NamedSharding(mesh, getattr(specs, k)))
+            for k, v in tree.items()})
+        self._overlay_rounds = int(windows)
+        self._phase1_ms = (
+            float(np.asarray(tree["tick"])) if self._faithful_overlay
+            else self._overlay_rounds * self._mean_delay)
 
     def state_pytree(self):
         """Host-gathered snapshot (np.asarray collects all shards).  The

@@ -17,13 +17,20 @@ import numpy as np
 from gossip_simulator_tpu.utils.metrics import Stats
 
 
-def save(ckpt_dir: str, window: int, tree: dict[str, Any], stats: Stats) -> str:
+def save(ckpt_dir: str, window: int, tree: dict[str, Any], stats: Stats,
+         prefix: str = "state", extra_meta: Optional[dict] = None) -> str:
+    """`prefix` namespaces the two phases: phase-2 snapshots are
+    ``state_*``, phase-1 overlay snapshots ``overlay_*``.  ``latest()``
+    sorts lexicographically, and "overlay" < "state", so any phase-2
+    snapshot outranks every phase-1 one -- resuming always continues from
+    the furthest phase."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    path = os.path.join(ckpt_dir, f"state_{window:08d}.npz")
+    path = os.path.join(ckpt_dir, f"{prefix}_{window:08d}.npz")
     arrays = {k: np.asarray(v) for k, v in tree.items()}
     np.savez_compressed(path, **arrays)
     with open(path + ".json", "w") as f:
-        json.dump({"window": window, **stats.to_dict()}, f)
+        json.dump({"window": window, **(extra_meta or {}),
+                   **stats.to_dict()}, f)
     return path
 
 
@@ -142,6 +149,61 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
         # negative (one int32 wrap reinterprets to the correct low word).
         tree["total_message"] = np.asarray(
             [0, int(tm) & 0xFFFFFFFF], dtype=np.uint32)
+    return tree
+
+
+def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
+    """Phase-1 counterpart of prepare_restore_tree: validate an overlay
+    snapshot (rounds OverlayState or ticks OverlayTickState) against this
+    run's config before the stepper re-shards it.  Unlike the phase-2
+    mail ring there is no repack path -- the packed window ring's slot
+    capacity and the emission-buffer widths are derived sizes, so the
+    snapshot restores only under geometry-identical settings; every
+    mismatch gets a restore-specific error naming the flag to fix."""
+    from gossip_simulator_tpu.models import overlay_ticks as ot
+
+    ckpt_mode = "ticks" if "ring_dst" in tree else "rounds"
+    if cfg.graph != "overlay":
+        raise ValueError(
+            "snapshot holds mid-construction overlay state but this run "
+            f"has -graph {cfg.graph}; restore with -graph overlay")
+    if ckpt_mode != cfg.overlay_mode_resolved:
+        raise ValueError(
+            f"overlay checkpoint was written by the {ckpt_mode} engine "
+            f"but this run resolves to {cfg.overlay_mode_resolved}; pass "
+            f"-overlay-mode {ckpt_mode} to restore it")
+    tree = dict(tree)
+    n, k = (int(d) for d in tree["friends"].shape)
+    if n != cfg.n:
+        raise ValueError(f"checkpoint has n={n} but this run has n={cfg.n}")
+    if k != cfg.max_degree:
+        raise ValueError(
+            f"checkpoint friend lists have capacity {k} but this config's "
+            f"max degree is {cfg.max_degree}; restore with the snapshot's "
+            "-fanout/-fanin")
+    n_local = n // n_shards
+    if ckpt_mode == "ticks":
+        dw = ot.ring_windows(cfg)
+        if tuple(tree["ring_cnt"].shape) != (n_shards, dw):
+            raise ValueError(
+                f"checkpoint window-ring shape {tuple(tree['ring_cnt'].shape)}"
+                f" does not match this config's ({n_shards}, {dw}); restore "
+                "on the snapshot's device count with its "
+                "-delaylow/-delayhigh")
+        cap = ot.slot_cap(cfg, n_local if n_shards > 1 else None)
+        want = n_shards * (dw * cap + 1)
+        if int(tree["ring_dst"].shape[0]) != want:
+            raise ValueError(
+                f"checkpoint ring length {int(tree['ring_dst'].shape[0])} "
+                f"does not match this config's {want} (slot cap {cap} x "
+                f"{dw} windows over {n_shards} shard(s))")
+    else:
+        cap_mb = cfg.mailbox_cap_for(n_local)
+        if int(tree["mk_dst"].shape[1]) != cap_mb + 2:
+            raise ValueError(
+                f"checkpoint emission buffers are {int(tree['mk_dst'].shape[1])}"
+                f" wide but this config's mailbox cap gives {cap_mb + 2}; "
+                "restore with the snapshot's -mailbox-cap / device count")
     return tree
 
 
