@@ -335,14 +335,36 @@ def packed_peer_state(received, crashed) -> jnp.ndarray:
     return received.astype(jnp.uint8) + crashed.astype(jnp.uint8) * 2
 
 
+def pushpull_chunk_cap(cfg: Config, n_local: int) -> int:
+    """Wave-compaction chunk for the push-pull round: rows per gathered
+    batch.  n/8 keeps the per-chunk (cap, f) draw+gather bounded (~29M
+    lanes at 10M x f=23) while early/late rounds with small active sets
+    run a single near-empty chunk; -compact-chunk overrides."""
+    if cfg.compact_chunk > 0:
+        return min(n_local, cfg.compact_chunk)
+    return min(n_local, max(4096, n_local // 8))
+
+
 def make_pushpull_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     """One synchronous push-pull anti-entropy round over uniform random peers
     (BASELINE.json config 3; no referent in the reference).  Push receptions
     are counted and can crash the receiver; pull responses from live peers are
-    counted; infection crosses any surviving contact."""
+    counted; infection crosses any surviving contact.
+
+    Round 4: the peer and drop draws are ROW-KEYED (utils/rng.row_keys),
+    so the wave-compacted path -- push over only the infected-live rows,
+    pull over only the susceptible rows, the SI engines' compaction
+    applied here -- draws exactly the dense path's values and stays
+    bit-identical to it (tested; `-compact off` forces the dense form).
+    The two active sets partition the live nodes, so compaction halves
+    the per-round gather/draw volume on top of skipping dead rows.
+    (Re-keying from the pre-r4 full-matrix draws changed this config's
+    trajectory once -- same distribution, new sample; bench totals moved
+    accordingly.)"""
     drop_p = p_eff(cfg, cfg.droprate)
     crash_p = p_eff(cfg, cfg.crashrate)
     f = cfg.fanout
+    compact = cfg.compact != "off"
 
     def round_fn(st: SimState, base_key: jax.Array) -> SimState:
         n = st.received.shape[0]
@@ -355,13 +377,46 @@ def make_pushpull_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
         live = ~st.crashed
         inf = st.received & live
         sus = ~st.received & live
+        packed = packed_peer_state(st.received, st.crashed)
 
-        # --- push: infected -> fanout random peers -------------------------
-        peers = jax.random.randint(k1, (n, f), 0, n, dtype=I32)
-        kept = ~_rng.bernoulli(kd1, drop_p, (n, f))
-        edge = inf[:, None] & kept
-        dst = jnp.where(edge, peers, n)
-        arriving = jnp.zeros((n,), I32).at[dst].add(1, mode="drop")
+        def compact_rows(mask, body, init):
+            """Run `body(idx, valid, carry)` over <=cap-row batches of
+            mask's True rows (the SI deposit_compact pattern)."""
+            cap = pushpull_chunk_cap(cfg, n)
+            chunks = (mask.sum(dtype=I32) + cap - 1) // cap
+
+            def step(_, carry):
+                state, remaining = carry
+                idx = first_true_indices(remaining, cap)
+                hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+                return body(idx, idx < n, state), remaining & ~hit
+
+            out, _ = jax.lax.fori_loop(0, chunks, step, (init, mask))
+            return out
+
+        # --- push: infected -> fanout random peers --------------------------
+        if compact:
+            def push_body(idx, v, arriving):
+                peers = _rng.row_randint(k1, n, idx, f)
+                kept = ~_rng.row_bernoulli(kd1, drop_p, idx, f)
+                edge = v[:, None] & kept
+                # Explicit trash cell (index n, in bounds): flat OOB-drop
+                # scatters have been miscompiled on this platform inside
+                # chunked fori loops (see deposit_local NOTE).
+                dst = jnp.where(edge, peers, n).reshape(-1)
+                return arriving.at[dst].add(1, mode="promise_in_bounds")
+
+            arriving = compact_rows(
+                inf, push_body, jnp.zeros((n + 1,), I32))[:n]
+        else:
+            rows = jnp.arange(n, dtype=I32)
+            peers = _rng.row_randint(k1, n, rows, f)
+            kept = ~_rng.row_bernoulli(kd1, drop_p, rows, f)
+            edge = inf[:, None] & kept
+            dst = jnp.where(edge, peers, n).reshape(-1)
+            arriving = jnp.zeros((n + 1,), I32).at[dst].add(
+                1, mode="promise_in_bounds")[:n]
+
         counted = jnp.where(live, arriving, 0)
         total_message = msg64_add(st.total_message, counted.sum(dtype=I32))
         if crash_p > 0.0:
@@ -373,16 +428,36 @@ def make_pushpull_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
         total_crashed = st.total_crashed + new_crash.sum(dtype=I32)
         newly_push = (counted > 0) & ~crashed & ~st.received
 
-        # --- pull: susceptible <- fanout random peers' state ---------------
-        peers2 = jax.random.randint(k2, (n, f), 0, n, dtype=I32)
-        kept2 = ~_rng.bernoulli(kd2, drop_p, (n, f))
-        req = sus[:, None] & kept2 & ~crashed[:, None]
-        # Peer state is gathered packed (see packed_peer_state); pre-round
-        # crashed (st.crashed) matches the old two-gather form.
-        peer_state = packed_peer_state(st.received, st.crashed)[peers2]
-        pull_hit = (req & (peer_state == 1)).any(axis=1)
-        total_message = msg64_add(total_message,
-                                  (req & (peer_state < 2)).sum(dtype=I32))
+        # --- pull: surviving susceptible <- fanout random peers' state ------
+        # A requester crashed by THIS round's push does not pull (its
+        # requests go uncounted) -- the pre-r4 ordering, preserved; peer
+        # state stays the pre-round snapshot.
+        puller = sus & ~new_crash
+        if compact:
+            def pull_body(idx, v, carry):
+                hit, msgs = carry
+                peers2 = _rng.row_randint(k2, n, idx, f)
+                kept2 = ~_rng.row_bernoulli(kd2, drop_p, idx, f)
+                req = v[:, None] & kept2
+                pstate = packed.at[peers2].get(mode="fill", fill_value=2)
+                rowhit = (req & (pstate == 1)).any(axis=1)
+                msgs = msgs + (req & (pstate < 2)).sum(dtype=I32)
+                hit = hit.at[jnp.where(v, idx, n)].max(
+                    rowhit, mode="promise_in_bounds")
+                return hit, msgs
+
+            pull_hit, pull_msgs = compact_rows(
+                puller, pull_body,
+                (jnp.zeros((n + 1,), bool), jnp.zeros((), I32)))
+            pull_hit = pull_hit[:n]
+        else:
+            peers2 = _rng.row_randint(k2, n, rows, f)
+            kept2 = ~_rng.row_bernoulli(kd2, drop_p, rows, f)
+            req = puller[:, None] & kept2
+            pstate = packed[peers2]
+            pull_hit = (req & (pstate == 1)).any(axis=1)
+            pull_msgs = (req & (pstate < 2)).sum(dtype=I32)
+        total_message = msg64_add(total_message, pull_msgs)
 
         newly = (newly_push | pull_hit) & ~crashed & ~st.received
         received = st.received | newly

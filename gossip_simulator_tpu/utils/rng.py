@@ -72,6 +72,15 @@ def row_bernoulli(key: jax.Array, p, rows: jax.Array, k: int) -> jax.Array:
     return jax.vmap(lambda kk: jax.random.bernoulli(kk, p, (k,)))(ks)
 
 
+def row_randint(key: jax.Array, n: int, rows: jax.Array, k: int) -> jax.Array:
+    """Uniform [0, n) int32 of shape (len(rows), k), row-keyed (see
+    row_keys) -- the peer draws of the push-pull round, keyed so the
+    wave-compacted path samples exactly the dense path's values."""
+    ks = row_keys(key, rows)
+    return jax.vmap(
+        lambda kk: jax.random.randint(kk, (k,), 0, n, dtype=jnp.int32))(ks)
+
+
 def row_uniform_delay(key: jax.Array, low: int, high: int,
                       rows: jax.Array) -> jax.Array:
     """Row-keyed integer delay in [low, high) ticks, clamped to >= 1

@@ -95,3 +95,20 @@ def test_multi_chunk_identical_sharded():
     on, off = _pair("sharded", compact_chunk=32)
     assert on.stats == off.stats
     assert on.stats.exchange_overflow == 0
+
+
+def test_pushpull_compact_identical():
+    """Round 4: the wave-compacted push-pull round (push over infected
+    rows, pull over surviving susceptible rows) must be bit-identical to
+    the dense row-keyed form -- the draws are row-keyed so compaction
+    samples exactly the dense path's values."""
+    on, off = _pair("jax", protocol="pushpull", coverage_target=0.95)
+    assert on.stats == off.stats
+
+
+def test_pushpull_compact_identical_chunked():
+    """Multi-chunk batches (chunk 64 at n=4000 forces many chunks at the
+    peak) must carry ranks/remaining across chunk boundaries."""
+    on, off = _pair("jax", protocol="pushpull", coverage_target=0.95,
+                    compact_chunk=64)
+    assert on.stats == off.stats
