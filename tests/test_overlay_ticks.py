@@ -226,3 +226,21 @@ def test_phase1_sizing_functions():
     # Rounds delivery chunk unchanged at its swept 64k optimum.
     assert overlay.delivery_chunk(Config(n=10_000_000),
                                   10_000_000) == 65_536
+
+
+def test_adaptive_drain_width_identical(monkeypatch):
+    """The occupancy-adaptive drain (lax.switch over descending sort
+    widths) must be trajectory-identical to the full-width form: the
+    live prefix is rank-packed, so any covering width sorts/delivers the
+    same entries.  Lowering the width floor drives the multi-branch
+    switch at test n (production only engages it at slot_cap > 262k)."""
+    import gossip_simulator_tpu.models.overlay_ticks as ot
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    cfg = Config(**{**BASE, "seed": 3}).validate()
+    base_res = run_simulation(cfg, printer=ProgressPrinter(False))
+    monkeypatch.setattr(ot, "_DRAIN_WIDTH_FLOOR", 64)
+    adapt_res = run_simulation(cfg, printer=ProgressPrinter(False))
+    assert adapt_res.stats == base_res.stats
+    assert adapt_res.stabilize_ms == base_res.stabilize_ms
