@@ -12,10 +12,14 @@
 // (done lazily by backends/cpp.py).
 
 #include <algorithm>
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <queue>
 #include <random>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -281,6 +285,221 @@ struct Sim {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Multithreaded SI baseline (windowed bulk-synchronous parallel DES).
+//
+// The strongest native tier the TPU headline is compared against should use
+// the whole host, not one core (VERDICT r3 stretch #8).  Because every
+// network delay is >= delaylow, events inside one delaylow-wide window are
+// causally independent -- the same insight the TPU event engine batches on
+// (models/event.py) -- so T threads each own a contiguous node shard,
+// process their shard's arrivals for the window, bucket the generated sends
+// by destination-owner thread, and exchange them at a barrier.  Same-window
+// arrival order is thread-interleaved rather than strictly time-ordered:
+// the batched-envelope divergence the framework already documents for its
+// own engines (README divergence table, "Same-tick crash ordering"); totals
+// are statistically identical (each message still gets its own drop draw,
+// each reception its own crash draw).  Scope: SI push on a static graph in
+// ticks mode -- exactly the bench headline's shape.
+// ---------------------------------------------------------------------------
+
+struct MtSim {
+  Params p;
+  int nthreads;
+  int64_t B;           // window width (ticks) = max(1, delaylow)
+  int dw;              // future-window ring depth
+  int64_t n_per;       // nodes per shard (ceil)
+  int64_t now = 0;     // ticks (window-aligned)
+  int64_t phase_start = 0;
+  std::vector<std::vector<int32_t>> friends;  // shared read-only after init
+  std::vector<uint8_t> received, crashed;     // owner-thread writes only
+  // buckets[t][w]: packed (arrival_tick << 32 | node) arrivals for thread
+  // t in absolute window (arrival_tick / B) (mod dw; dw covers the whole
+  // in-flight horizon, (B-1) + delayhigh).
+  std::vector<std::vector<std::vector<int64_t>>> buckets;
+  // out[src][dst]: staged sends, exchanged at the barrier.
+  std::vector<std::vector<std::vector<int64_t>>> out;
+  std::vector<std::mt19937_64> rngs;
+  std::vector<int64_t> t_message, t_received, t_crashed;
+  std::mt19937_64 rng0;
+
+  int owner(int64_t node) const { return int(node / n_per); }
+
+  void init() {
+    B = p.delaylow < 1 ? 1 : p.delaylow;
+    dw = int((B - 1 + p.delayhigh + B - 1) / B) + 1;
+    n_per = (p.n + nthreads - 1) / nthreads;
+    rng0.seed(uint64_t(p.seed));
+    received.assign(p.n, 0);
+    crashed.assign(p.n, 0);
+    friends.assign(p.n, {});
+    // Same kout generator discipline as Sim::gen_static (single-threaded:
+    // graph build is not the benchmarked phase).
+    for (int64_t i = 0; i < p.n; ++i) {
+      friends[i].reserve(p.fanout);
+      for (int32_t j = 0; j < p.fanout; ++j) {
+        int64_t x = std::uniform_int_distribution<int64_t>(0, p.n - 1)(rng0);
+        if (x == i) x = (x + 1) % p.n;
+        friends[i].push_back(int32_t(x));
+      }
+    }
+    buckets.assign(nthreads, std::vector<std::vector<int64_t>>(dw));
+    out.assign(nthreads, std::vector<std::vector<int64_t>>(nthreads));
+    rngs.resize(nthreads);
+    for (int t = 0; t < nthreads; ++t)
+      rngs[t].seed(uint64_t(p.seed) * 0x9E3779B97F4A7C15ull + t + 1);
+    t_message.assign(nthreads, 0);
+    t_received.assign(nthreads, 0);
+    t_crashed.assign(nthreads, 0);
+  }
+
+  // Stage node's broadcast from thread t at tick `send`: one shared delay
+  // per broadcast, per-link drop (simulator.go:140-149).
+  void stage_broadcast(int t, int64_t node, int64_t send) {
+    auto& rng = rngs[t];
+    int64_t d =
+        p.delaylow +
+        std::uniform_int_distribution<int64_t>(0, p.delayhigh - p.delaylow - 1)(
+            rng);
+    if (d < 1) d = 1;
+    int64_t arr = send + d;
+    for (int32_t f : friends[node]) {
+      double q = p.droprate;
+      if (q > 0.0 &&
+          std::uniform_real_distribution<double>(0.0, 1.0)(rng) < q)
+        continue;
+      out[t][owner(f)].push_back((arr << 32) | uint32_t(f));
+    }
+  }
+
+  // Move staged sends addressed to `owner_t` into its future buckets --
+  // called with one task per OWNER, so each bucket has exactly one writer.
+  void ingest_and_clear(int owner_t) {
+    for (int s = 0; s < nthreads; ++s) {
+      auto& v = out[s][owner_t];
+      for (int64_t packed : v) {
+        int64_t arr = packed >> 32;
+        buckets[owner_t][(arr / B) % dw].push_back(packed);
+      }
+      v.clear();
+    }
+  }
+
+  void seed() {
+    phase_start = now;
+    int64_t sender = std::uniform_int_distribution<int64_t>(0, p.n - 1)(rng0);
+    received[sender] = 1;
+    t_received[0]++;
+    stage_broadcast(0, sender, now);
+    for (int t = 0; t < nthreads; ++t) ingest_and_clear(t);
+  }
+
+  void process_bucket(int t, int64_t wslot) {
+    auto& rng = rngs[t];
+    auto& bucket = buckets[t][wslot];
+    for (int64_t packed : bucket) {
+      int32_t dst = int32_t(packed & 0xFFFFFFFF);
+      if (crashed[dst]) continue;  // black-hole, uncounted
+      t_message[t]++;
+      if (p.crashrate > 0.0 &&
+          std::uniform_real_distribution<double>(0.0, 1.0)(rng) <
+              p.crashrate) {
+        crashed[dst] = 1;
+        t_crashed[t]++;
+        continue;
+      }
+      if (received[dst]) continue;
+      received[dst] = 1;
+      t_received[t]++;
+      stage_broadcast(t, dst, packed >> 32);
+    }
+    bucket.clear();
+  }
+
+  // Persistent worker pool: one thread per shard for the whole run (a
+  // spawn-per-window variant costs 2*nthreads create/join cycles per
+  // B-tick window, deflating the measured rate on many-core hosts --
+  // exactly the bias this baseline exists to avoid).  Phases alternate
+  // process (own bucket) and ingest (own inbound staging), separated by
+  // the generation barrier.
+  std::vector<std::thread> pool;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  int64_t generation = 0;
+  int phase = 0;  // 1 = process, 2 = ingest
+  int pending = 0;
+  bool stopping = false;
+  int64_t cur_wslot = 0;
+
+  void pool_worker(int t) {
+    int64_t seen = 0;
+    while (true) {
+      int ph;
+      int64_t ws;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return stopping || generation > seen; });
+        if (stopping) return;
+        seen = generation;
+        ph = phase;
+        ws = cur_wslot;
+      }
+      if (ph == 1) process_bucket(t, ws);
+      else ingest_and_clear(t);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (--pending == 0) cv_done.notify_one();
+      }
+    }
+  }
+
+  void run_phase(int ph, int64_t wslot) {
+    if (pool.empty()) {
+      pool.reserve(nthreads);
+      for (int t = 0; t < nthreads; ++t)
+        pool.emplace_back(&MtSim::pool_worker, this, t);
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    phase = ph;
+    cur_wslot = wslot;
+    pending = nthreads;
+    ++generation;
+    cv_work.notify_all();
+    cv_done.wait(lk, [&] { return pending == 0; });
+  }
+
+  ~MtSim() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_work.notify_all();
+    for (auto& th : pool) th.join();
+  }
+
+  // One B-tick window: threads process their own bucket (same-window
+  // arrival order is thread-local push order -- the batched envelope),
+  // stage sends, barrier, then ingest in parallel per owner.
+  void run_window() {
+    int64_t wslot = (now / B) % dw;
+    run_phase(1, wslot);
+    run_phase(2, wslot);
+    now += B;
+  }
+
+  void gossip_window(double win) {
+    int64_t steps = int64_t((win + double(B) - 1) / double(B));
+    for (int64_t i = 0; i < steps; ++i) run_window();
+  }
+
+  bool exhausted() const {
+    for (int t = 0; t < nthreads; ++t)
+      for (const auto& b : buckets[t])
+        if (!b.empty()) return false;
+    return true;
+  }
+};
+
 }  // namespace
 
 extern "C" {
@@ -290,7 +509,8 @@ extern "C" {
 // v2: sim_stats gained out[6] = SIR removed count.
 // v3: sim_stats takes n_slots (caller buffer length) and writes at most
 //     min(n_slots, 7) entries, so future slot growth is skew-safe.
-int32_t sim_abi_version() { return 3; }
+// v4: mt_* multithreaded SI baseline API added.
+int32_t sim_abi_version() { return 4; }
 
 void* sim_create(int64_t n, int32_t fanout, int32_t fanin, int32_t delaylow,
                  int32_t delayhigh, double droprate, double crashrate,
@@ -346,6 +566,40 @@ double sim_phase_start(void* h) { return static_cast<Sim*>(h)->phase_start; }
 void sim_degrees(void* h, int32_t* out) {
   Sim* s = static_cast<Sim*>(h);
   for (int64_t i = 0; i < s->p.n; ++i) out[i] = int32_t(s->friends[i].size());
+}
+
+// --- multithreaded SI baseline (MtSim) -------------------------------------
+
+void* mt_create(int64_t n, int32_t fanout, int32_t delaylow, int32_t delayhigh,
+                double droprate, double crashrate, int32_t seed,
+                int32_t nthreads) {
+  MtSim* s = new MtSim();
+  s->p = {n, fanout, fanout + 1, delaylow, delayhigh, droprate, crashrate,
+          0.0,  0.0, SI, KOUT, 0, 0, seed};
+  s->nthreads = nthreads < 1 ? 1 : nthreads;
+  s->init();
+  return s;
+}
+
+void mt_destroy(void* h) { delete static_cast<MtSim*>(h); }
+void mt_seed(void* h) { static_cast<MtSim*>(h)->seed(); }
+void mt_gossip_window(void* h, double win) {
+  static_cast<MtSim*>(h)->gossip_window(win);
+}
+void mt_stats(void* h, int64_t* out, int32_t n_slots) {
+  MtSim* s = static_cast<MtSim*>(h);
+  int64_t vals[4] = {0, 0, 0, s->exhausted() ? 1 : 0};
+  for (int t = 0; t < s->nthreads; ++t) {
+    vals[0] += s->t_received[t];
+    vals[1] += s->t_message[t];
+    vals[2] += s->t_crashed[t];
+  }
+  int32_t k = n_slots < 4 ? n_slots : 4;
+  for (int32_t i = 0; i < k; ++i) out[i] = vals[i];
+}
+double mt_now(void* h) { return double(static_cast<MtSim*>(h)->now); }
+double mt_phase_start(void* h) {
+  return double(static_cast<MtSim*>(h)->phase_start);
 }
 
 }  // extern "C"

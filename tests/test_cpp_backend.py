@@ -57,3 +57,39 @@ def test_cpp_determinism():
     r1, _ = _run(n=3000, seed=7)
     r2, _ = _run(n=3000, seed=7)
     assert r1.stats == r2.stats
+
+
+def test_cpp_mt_statistical_parity():
+    """The multithreaded C++ baseline must match the serial C++ oracle's
+    totals statistically (same SI semantics, batched same-window
+    envelope): coverage and message totals within a few percent at the
+    same config, crash counts in the same band."""
+    import pytest
+
+    from gossip_simulator_tpu.backends.cpp import CppMtStepper, CppStepper
+
+    cfg = Config(n=200_000, fanout=3, graph="kout", seed=0, backend="cpp",
+                 crashrate=0.001, coverage_target=0.90,
+                 progress=False).validate()
+    out = {}
+    for name, s in (("serial", CppStepper(cfg)), ("mt", CppMtStepper(cfg,
+                                                                     nthreads=4))):
+        s.init()
+        while not s.overlay_window()[2]:
+            pass
+        s.seed()
+        for _ in range(500):
+            st = s.gossip_window()
+            if st.coverage >= 0.90 or s.exhausted:
+                break
+        out[name] = st
+    a, b = out["serial"], out["mt"]
+    assert b.coverage >= 0.90
+    assert abs(a.total_message - b.total_message) / a.total_message < 0.05
+    assert abs(a.total_crashed - b.total_crashed) < max(
+        60, 0.3 * a.total_crashed)
+
+    # Unsupported shapes are rejected, not silently wrong.
+    s = CppMtStepper(cfg.replace(protocol="sir", removal_rate=0.1))
+    with pytest.raises(ValueError, match="cpp_mt supports"):
+        s.init()
