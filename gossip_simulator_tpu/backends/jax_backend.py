@@ -59,6 +59,7 @@ class JaxStepper(Stepper):
         bootstrap burst here would be thrown away."""
         cfg = self.cfg
         self._faithful_overlay = cfg.overlay_mode_resolved == "ticks"
+        self._osplit = False
         if self._faithful_overlay:
             from gossip_simulator_tpu.models import overlay_ticks
 
@@ -68,7 +69,16 @@ class JaxStepper(Stepper):
                            if build_state else None)
         else:
             self._omod = overlay
-            self._oround = jax.jit(overlay.make_round_fn(cfg))
+            self._osplit = overlay.use_split_round(cfg)
+            if self._osplit:
+                # Memory scale: one round as two jitted calls so donation
+                # can alias dead buffers across the boundary (the fused
+                # round held ~19.5 GB at n=1e8 -- overlay.make_split_
+                # round_fn).  Host pays two dispatches per round; a round
+                # is seconds of device work at this n.
+                self._oround = overlay.make_split_round_fn(cfg)
+            else:
+                self._oround = jax.jit(overlay.make_round_fn(cfg))
             self.ostate = overlay.init_state(cfg) if build_state else None
         self._overlay_done = False
         self._orun = None  # lazy: compiled only on the fast path
@@ -105,6 +115,23 @@ class JaxStepper(Stepper):
         (windows_run, quiesced)."""
         if self._overlay_done:
             return 0, True
+        if getattr(self, "_osplit", False):
+            # Split-round mode (memory scale): the bounded device-side
+            # while_loop would re-fuse the round into one program and
+            # re-create the OOM; run the host loop instead -- a round is
+            # seconds of device work at this n, so the per-round
+            # dispatch + quiescence sync is noise.
+            q = False
+            while self._overlay_rounds < max_windows:
+                self.ostate = self._oround(self.ostate, self.key)
+                self._overlay_rounds += 1
+                self._phase1_ms = self._overlay_rounds * self._mean_delay
+                q = bool(jax.device_get(self._omod.quiesced(self.ostate)))
+                if q:
+                    break
+            if q:
+                self._finish_overlay()
+            return self._overlay_rounds, q
         if self._orun is None:
             self._orun = self._omod.make_run_fn(self.cfg)
         if budget is None:

@@ -42,6 +42,10 @@ ENGINES = ("auto", "ring", "event")
 # (measured: ticks costs ~0.5s at 100k, ~11s at 1M, 3-4x rounds mode
 # above -- README "Overlay mode at scale").
 OVERLAY_TICKS_AUTO_MAX = 1_000_000
+# The auto mailbox cap drops 16 -> 8 at this many local rows (see
+# Config.mailbox_cap_for: emission-buffer memory, not overflow risk,
+# is what the cap costs at scale).
+MAILBOX_CAP_MEMORY_BAND = 32_000_000
 
 
 @dataclasses.dataclass(frozen=True)
@@ -198,6 +202,15 @@ class Config:
         return "rounds" if self.protocol == "pushpull" else self.time_mode
 
     @property
+    def checkpointing_enabled(self) -> bool:
+        """Snapshots can actually be written: BOTH -checkpoint-every and
+        -checkpoint-dir are set.  THE predicate for every gate that trades
+        the fast paths for per-window observability (driver phase-1/2
+        gates, _Checkpointer._due) -- they drifted when each spelled it
+        out (advisor r4)."""
+        return bool(self.checkpoint_every and self.checkpoint_dir)
+
+    @property
     def overlay_mode_resolved(self) -> str:
         """Size-banded 'auto' resolution (see the field comment): ticks at
         n <= OVERLAY_TICKS_AUTO_MAX on tick-semantics runs, rounds
@@ -248,25 +261,32 @@ class Config:
         in ticks runs for n_local in (~6.7e7, 1.34e8] for no reason)."""
         if self.mailbox_cap > 0:
             return self.mailbox_cap
-        # Balls-in-bins: with <=N uniform messages into N bins the max load is
-        # ~ln N/ln ln N w.h.p. (~6.3 at N=1e8); 16 is comfortably beyond it
-        # for any feasible N.  Past n_rows ~ 1.34e8, (n_rows+1)*16 overflows
-        # the flat int32 mailbox addressing and delivery would silently take
-        # the ~15x dense 2-D-scatter path (ops/mailbox.deliver) -- auto-shrink
-        # to 8 there (still above the max-load bound; overflow is counted,
-        # never silent), which keeps flat addressing to n_rows ~ 2.7e8.
-        # Beyond THAT the dense fallback engages and deliver's one-time
-        # warning names it.  The tick-faithful engine's fused delivery
-        # (ops/mailbox.deliver_pair) additionally wants the STACKED
-        # [2n, cap] addressing, so ticks mode shrinks at HALF that
-        # boundary (~6.7e7) -- keeping the one-pass path to ~1.34e8 (the
-        # 100M flagship); its fallback past the shrunk bound is two
-        # deliver() passes, not the dense path.
+        # Balls-in-bins: with <=N uniform messages into N bins the max load
+        # is ~ln N/ln ln N w.h.p. (~6.3 at N=1e8), so BOTH 16 and 8 put
+        # overflow in the negligible band (and overflow is counted, never
+        # silent).  Two size-banded shrinks to 8:
+        # * MEMORY (round 4): the rounds overlay holds (n, cap+2) makeup
+        #   + (n, cap) breakup emission buffers -- at cap 16 that is
+        #   13.6 GB for n=1e8, over the 16 GB v5e HBM by itself.  Cap 8
+        #   halves it and makes the reference-default 100M two-phase
+        #   pipeline fit a single chip.  The band sits above every
+        #   measured/golden-pinned config (<= 10M rows keep cap 16).
+        # * ADDRESSING: past n_rows ~ 1.34e8, (n_rows+1)*16 overflows the
+        #   flat int32 mailbox addressing and delivery would silently
+        #   take the ~15x dense 2-D-scatter path (ops/mailbox.deliver);
+        #   cap 8 keeps flat addressing to n_rows ~ 2.7e8.  Beyond THAT
+        #   the dense fallback engages and deliver's one-time warning
+        #   names it.  deliver_pair's STACKED [2n, cap] addressing
+        #   (stacked=True consumers) stops fitting at ~1.34e8 even at
+        #   cap 8; its fallback is two deliver() passes, not the dense
+        #   path.  The memory band (3.2e7) sits below both addressing
+        #   boundaries, so the fits() checks are a backstop kept EXACTLY
+        #   as the delivery paths consult them (deliver_pair checks
+        #   fits(2n+1, cap); deliver checks fits(n, cap)).
         from gossip_simulator_tpu.ops.mailbox import flat_addressing_fits
 
-        # EXACTLY the gates the delivery paths consult (deliver_pair
-        # checks fits(2n+1, cap); deliver checks fits(n, cap)) so the two
-        # bounds can never drift by an off-by-one.
+        if n_rows >= MAILBOX_CAP_MEMORY_BAND:
+            return 8
         rows = 2 * n_rows + 1 if stacked else n_rows
         if not flat_addressing_fits(rows, 16):
             return 8

@@ -110,7 +110,7 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         # one dispatch + device_get per 10 simulated ms).  Checkpointing
         # observes per-window state too, so it takes the windowed loop
         # (same rule as phase 2's `fast` gate).
-        if (not printer.observing and not cfg.checkpoint_every
+        if (not printer.observing and not cfg.checkpointing_enabled
                 and hasattr(stepper, "overlay_run_to_quiescence")):
             overlay_windows, oq = stepper.overlay_run_to_quiescence(
                 max_overlay_windows)
@@ -160,7 +160,7 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     # at n=1e7 through the TPU tunnel).  Gates on the PRINTER's
     # observability, not just cfg: a caller-supplied window-printing or
     # JSONL printer must keep receiving per-window callbacks.
-    fast = (not resumed and not cfg.checkpoint_every
+    fast = (not resumed and not cfg.checkpointing_enabled
             and not printer.observing
             and hasattr(stepper, "run_to_target"))
     with _maybe_profile(cfg):
@@ -209,8 +209,8 @@ class _Checkpointer:
 
     def _due(self, window: int) -> bool:
         cfg = self.cfg
-        return bool(cfg.checkpoint_every and cfg.checkpoint_dir
-                    and window % cfg.checkpoint_every == 0)
+        return (cfg.checkpointing_enabled
+                and window % cfg.checkpoint_every == 0)
 
     def maybe_save(self, window: int, stats: Stats) -> None:
         if not self._due(window):

@@ -33,6 +33,7 @@ messages (the reference's polled check can terminate early, SURVEY §5.2).
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, NamedTuple
 
 import jax
@@ -61,13 +62,18 @@ def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     # Per-LOCAL-rows cap: one shard's slice keeps cap 16 far beyond the
     # single-device flat-addressing boundary (config.mailbox_cap_for).
     cap = cfg.mailbox_cap_for(n)
-    em, eb = cap + 2, cap
     z = lambda: jnp.zeros((), I32)
+    # Emission buffers are SLOT-major (cap, n): the huge node axis is
+    # minormost and the slot count tiles T(8,128) exactly (see the
+    # OverlayState field comment -- node-major and off-multiple slot
+    # counts both padded catastrophically at n=1e8); bootstrap emissions
+    # are their own flat vector.
     return OverlayState(
         friends=jnp.full((n, k), -1, I32),
         friend_cnt=jnp.zeros((n,), I32),
-        mk_dst=jnp.full((n, em), -1, I32),
-        bk_dst=jnp.full((n, eb), -1, I32),
+        mk_dst=jnp.full((cap, n), -1, I32),
+        bk_dst=jnp.full((cap, n), -1, I32),
+        boot_dst=jnp.full((n,), -1, I32),
         round=z(), makeups=z(), breakups=z(),
         win_makeups=z(), win_breakups=z(), mailbox_dropped=z(),
     )
@@ -188,7 +194,12 @@ def make_round_fn(cfg: Config,
     k = cfg.max_degree
     fanout, fanin = cfg.fanout, cfg.fanin_resolved
     cap = cfg.mailbox_cap_for(n_rows if n_rows is not None else n)
-    em, eb = cap + 2, cap
+    # Mailboxes come back either 2-D (n, cap) or FLAT rank-major
+    # (cap*n + 1; slot r contiguous at [r*n, (r+1)*n)) -- the large-n
+    # path never materializes the (n, cap) shape, whose narrow minor dim
+    # TPU tile layouts pad to 128 lanes (s32[1e8, 8] -> 51 GB, the
+    # round-4 compile OOM).  `_slot(mbox, r)` reads slot r either way.
+    flat_mbox = False
     if deliver_fn is None:
         # Emission lists are mostly empty once membership settles: compact
         # before the delivery sort (chunk sweep: see delivery_chunk).
@@ -197,98 +208,156 @@ def make_round_fn(cfg: Config,
                                                       flat_addressing_fits)
 
         if n > COLUMN_DELIVERY_MIN_ROWS and flat_addressing_fits(n, cap):
-            # Per-COLUMN delivery: same entries at ~1/cols the compaction
+            # Per-SLOT delivery: same entries at ~1/slots the compaction
             # scan width (deliver_columns' rationale; the flattened form
             # was 84% of the round at 10M nodes: 42.5 -> 25.3 s there).
-            # Arrival order becomes column-major.  Below ~4M rows the
-            # per-column machinery is op-floor-bound (34 columns x
-            # ceil-per-column chunks measured 4x SLOWER at 1M) and the
-            # flattened row-major path stays -- the canonical arrival
+            # Arrival order becomes slot-major.  Below ~4M rows the
+            # per-slot machinery is op-floor-bound (34 slots x
+            # ceil-per-slot chunks measured 4x SLOWER at 1M) and the
+            # flattened node-major path stays -- the canonical arrival
             # order is size-banded, deterministic per config, and pinned
             # by the goldens at small n.
-            def deliver_matrix_fn(mat, cap):
-                return deliver_columns(mat, n, cap, dchunk)
+            flat_mbox = True
+
+            def deliver_matrix_fn(mats, cap, dep=None):
+                carry = None
+                if dep is not None:
+                    # Sequence this delivery's buffer allocations after
+                    # `dep` so they reuse the previous delivery's dead
+                    # buffers (see _dep_full).
+                    carry = (_dep_full((n * cap + 1,), -1, dep),
+                             _dep_full((n + 1,), 0, dep),
+                             jnp.zeros((), I32))
+                return deliver_columns(mats, n, cap, dchunk, flat=True,
+                                       carry=carry)
         else:
             # Small-n path, and past the flat-addressing boundary the
             # flattened path's dense 2-D fallback + one-time warning.
-            def deliver_matrix_fn(mat, cap):
-                flat = mat.reshape(-1)
-                mbox, _, dropped = deliver(None, flat, flat >= 0, n, cap,
-                                           compact_chunk=dchunk,
-                                           src_cols=mat.shape[1])
-                return mbox, dropped
+            # Slot-major flatten, matching the per-slot path's arrival
+            # order exactly (sender = flat_idx % n) -- the canonical
+            # order no longer changes across the size band.
+            def deliver_matrix_fn(mats, cap, dep=None):
+                flat = jnp.concatenate(mats, axis=0).reshape(-1)
+                mbox, cnt, dropped = deliver(None, flat, flat >= 0, n, cap,
+                                             compact_chunk=dchunk,
+                                             src_mod=n)
+                return mbox, cnt.max(initial=0), dropped
     else:
         # Hook supplied (the sharded backend's routed delivery): keep its
         # flattened (src, dst, valid) contract; the ids broadcast is only
-        # materialized on this path.
-        def deliver_matrix_fn(mat, cap):
-            flat = mat.reshape(-1)
-            ids_b = jnp.broadcast_to(ids_fn()[:, None],
-                                     mat.shape).reshape(-1)
-            return deliver_fn(ids_b, flat, flat >= 0, cap)
+        # materialized on this path.  Slot-major flatten (the emission
+        # buffers' native layout; transposing at shard scale would
+        # materialize the padded node-major shape).
+        def deliver_matrix_fn(mats, cap, dep=None):
+            matc = jnp.concatenate(mats, axis=0)
+            flat = matc.reshape(-1)
+            ids_b = jnp.broadcast_to(ids_fn()[None, :],
+                                     matc.shape).reshape(-1)
+            mbox, dropped = deliver_fn(ids_b, flat, flat >= 0, cap)
+            return mbox, (mbox >= 0).sum(axis=1, dtype=I32).max(initial=0), \
+                dropped
     if ids_fn is None:
         ids_fn = lambda: jnp.arange(n, dtype=I32)
     if sum_fn is None:
         sum_fn = lambda x: x
 
-    def round_fn(st: OverlayState, base_key: jax.Array) -> OverlayState:
+    def _slot(mbox, r):
+        """Mailbox slot r for every node: contiguous dynamic_slice on the
+        flat rank-major layout, column read on the 2-D one."""
+        if flat_mbox:
+            return jax.lax.dynamic_slice(mbox, (r * n,), (n,))
+        return mbox[:, r]
+
+    def _dep_full(shape, fill, dep):
+        """Constant fill whose ALLOCATION is sequenced after `dep`: a
+        plain jnp.full lowers to broadcast(constant), which XLA hoists to
+        program start -- at n=1e8 that made every multi-GB buffer of the
+        round co-live (both mailboxes + both emission buffers, 19.5 GB on
+        a 15.75 GB chip).  Mixing a computed scalar in keeps the buffer's
+        live range where the dataflow says it starts, letting it reuse a
+        dead predecessor's allocation."""
+        return jnp.broadcast_to(jnp.int32(fill) + dep * jnp.int32(0), shape)
+
+    # --- the four round pieces -------------------------------------------
+    # Factored so the fused round_fn and the memory-scale split variant
+    # (make_split_round_fn: one jitted call PER PIECE) run the exact same
+    # closures -- only the jit boundary moves.
+
+    def p_bk_deliver(bk_dst):
+        """Deliver last round's BREAKUP emissions."""
+        return deliver_matrix_fn((bk_dst,), cap)
+
+    def p_bk_process(friends, cnt, bk_mbox, n_bk, drop2, round_, base_key):
+        """Process the breakup mailbox (simulator.go:76-94), emitting
+        replacement makeups into mk_em."""
         ids = ids_fn()  # GLOBAL ids of local rows (identity comparisons)
-        n_local = ids.shape[0]
-        rows = jnp.arange(n_local, dtype=I32)  # LOCAL row indices (indexing)
-        rkey = jax.random.fold_in(base_key, st.round)
-
-        # --- 1. deliver last round's emissions into mailboxes -------------
-        mk_mbox, drop1 = deliver_matrix_fn(st.mk_dst, cap)
-        bk_mbox, drop2 = deliver_matrix_fn(st.bk_dst, cap)
-        dropped = st.mailbox_dropped + sum_fn(drop1 + drop2)
-
-        friends, cnt = st.friends, st.friend_cnt
-        mk_em = jnp.full((n_local, em), -1, I32)
-        bk_em = jnp.full((n_local, eb), -1, I32)
-        win_mk = jnp.zeros((), I32)
+        rkey = jax.random.fold_in(base_key, round_)
+        # mk_em allocates after the bk delivery (see _dep_full).
+        mk_em = _dep_full((cap, ids.shape[0]), -1, drop2)
         win_bk = jnp.zeros((), I32)
 
-        # --- 2. process breakup mailbox (slot-sequential, node-parallel) ---
-        # simulator.go:76-94.
         def bk_body(slot, carry):
             friends, cnt, mk_em, win_bk = carry
-            src = bk_mbox[:, slot]
+            src = _slot(bk_mbox, slot)
             has = src >= 0
             kk = jax.random.fold_in(
                 jax.random.fold_in(rkey, _rng.OP_REPLACE), slot)
             friends, cnt, nf, rp = process_breakup_slot(
                 n, fanout, friends, cnt, src, has, ids, kk)
-            mk_em = mk_em.at[:, slot].set(jnp.where(rp, nf, -1))
+            mk_em = mk_em.at[slot].set(jnp.where(rp, nf, -1))
             return friends, cnt, mk_em, win_bk + has.sum(dtype=I32)
 
-        # Slot loops run to the MAX mailbox load this round, not the fixed
-        # capacity: slots are rank-contiguous, so everything past a node's
-        # count is -1 (a no-op slot), and typical max load is ~ln n/ln ln n
-        # << cap.  Local data-dependent trip counts are fine under
-        # shard_map: the bodies contain no collectives.
-        n_bk = (bk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
-        friends, cnt, mk_em, win_bk = jax.lax.fori_loop(
+        # Slot loops run to the MAX mailbox load this round (n_mk/n_bk from
+        # the delivery), not the fixed capacity: slots are rank-contiguous,
+        # so everything past a node's count is -1 (a no-op slot), and
+        # typical max load is ~ln n/ln ln n << cap.  Local data-dependent
+        # trip counts are fine under shard_map: the bodies contain no
+        # collectives.
+        return jax.lax.fori_loop(
             0, n_bk, bk_body, (friends, cnt, mk_em, win_bk))
 
-        # --- 3. process makeup mailbox -------------------------------------
-        # simulator.go:66-75.
+    def p_mk_deliver(mk_dst, boot_dst, friends, cnt, win_bk):
+        """Deliver the MAKEUP emissions (the breakup mailbox is dead by
+        now -- holding both ~3 GB mailboxes alive broke the 16 GB chip at
+        n=1e8; sequencing is bit-identical since the deliveries are
+        data-independent).  Bootstrap makeups ride as one extra slot row
+        AFTER the replies -- the same order the old (cap+2)-wide buffer
+        delivered.  The optimization_barrier keeps XLA from hoisting this
+        above the breakup processing in the fused form."""
+        mk_src, boot_src, friends, cnt = jax.lax.optimization_barrier(
+            (mk_dst, boot_dst, friends, cnt))
+        mk_mbox, n_mk, drop1 = deliver_matrix_fn(
+            (mk_src, boot_src[None, :]), cap, dep=win_bk)
+        return mk_mbox, n_mk, drop1, friends, cnt
+
+    def p_mk_process(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em,
+                     win_bk, round_, makeups0, breakups0, dropped0,
+                     base_key) -> OverlayState:
+        """Process the makeup mailbox (simulator.go:66-75), bootstrap
+        (simulator.go:95-106) and assemble the next state."""
+        ids = ids_fn()
+        n_local = ids.shape[0]
+        rows = jnp.arange(n_local, dtype=I32)  # LOCAL row indices
+        rkey = jax.random.fold_in(base_key, round_)
+        bk_em = _dep_full((cap, n_local), -1, win_bk)
+        dropped = dropped0 + sum_fn(drop1 + drop2)
+        win_mk = jnp.zeros((), I32)
+
         def mk_body(slot, carry):
             friends, cnt, bk_em, win_mk = carry
-            src = mk_mbox[:, slot]
+            src = _slot(mk_mbox, slot)
             has = src >= 0
             kk = jax.random.fold_in(
                 jax.random.fold_in(rkey, _rng.OP_EVICT), slot)
             friends, cnt, victim, ev = process_makeup_slot(
                 fanin, friends, cnt, src, has, kk)
-            bk_em = bk_em.at[:, slot].set(jnp.where(ev, victim, -1))
+            bk_em = bk_em.at[slot].set(jnp.where(ev, victim, -1))
             return friends, cnt, bk_em, win_mk + has.sum(dtype=I32)
 
-        n_mk = (mk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
         friends, cnt, bk_em, win_mk = jax.lax.fori_loop(
             0, n_mk, mk_body, (friends, cnt, bk_em, win_mk))
 
-        # --- 4. bootstrap: one friend per round while under fanout ---------
-        # simulator.go:95-106.
+        # --- bootstrap: one friend per round while under fanout ------------
         kb = jax.random.fold_in(rkey, _rng.OP_BOOTSTRAP)
         under = cnt < fanout
         w = jax.random.randint(kb, (n_local,), 0, n, dtype=I32)
@@ -296,7 +365,7 @@ def make_round_fn(cfg: Config,
         appcol = jnp.minimum(cnt, k - 1)
         friends = _masked_set(friends, rows, appcol, w, under)
         cnt = cnt + under.astype(I32)
-        mk_em = mk_em.at[:, em - 1].set(jnp.where(under, w, -1))
+        boot_em = jnp.where(under, w, -1)
 
         # Global reductions (psum when sharded): window counts feed both the
         # progress lines and the quiescence predicate, so they must be the
@@ -305,13 +374,96 @@ def make_round_fn(cfg: Config,
         win_bk = sum_fn(win_bk)
         return OverlayState(
             friends=friends, friend_cnt=cnt, mk_dst=mk_em, bk_dst=bk_em,
-            round=st.round + 1,
-            makeups=st.makeups + win_mk, breakups=st.breakups + win_bk,
+            boot_dst=boot_em,
+            round=round_ + 1,
+            makeups=makeups0 + win_mk, breakups=breakups0 + win_bk,
             win_makeups=win_mk, win_breakups=win_bk,
             mailbox_dropped=dropped,
         )
 
+    def round_fn(st: OverlayState, base_key: jax.Array) -> OverlayState:
+        bk_mbox, n_bk, drop2 = p_bk_deliver(st.bk_dst)
+        friends, cnt, mk_em, win_bk = p_bk_process(
+            st.friends, st.friend_cnt, bk_mbox, n_bk, drop2, st.round,
+            base_key)
+        mk_mbox, n_mk, drop1, friends, cnt = p_mk_deliver(
+            st.mk_dst, st.boot_dst, friends, cnt, win_bk)
+        return p_mk_process(
+            mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em, win_bk,
+            st.round, st.makeups, st.breakups, st.mailbox_dropped, base_key)
+
+    # make_split_round_fn's seam.
+    round_fn.pieces = (p_bk_deliver, p_bk_process, p_mk_deliver,
+                       p_mk_process)
     return round_fn
+
+
+# Above this many rows the single-device rounds engine runs each round as
+# FOUR jitted calls (make_split_round_fn): one fused round holds ~19.5 GB
+# at n=1e8 (donated state is reserved for the whole call, so the delivery
+# temps cannot reuse it, and the co-live temp set fragments badly) while
+# the split pieces each hold one multi-GB temp and free dead buffers at
+# every call boundary via donation (~13 GB peaks).  Module-level so a CPU
+# test can lower it and pin split == fused.
+SPLIT_ROUND_MIN_ROWS = 32_000_000
+
+
+def make_split_round_fn(cfg: Config):
+    """One overlay round as four jitted calls (see SPLIT_ROUND_MIN_ROWS).
+    Bit-identical to the fused round_fn -- both compose the SAME four
+    piece closures; only the jit boundaries move.  Every call donates all
+    its array arguments, so each phase's dead buffers (bk_dst after its
+    delivery, the bk mailbox after breakup processing, mk_dst/boot after
+    the mk delivery, the mk mailbox at the end) are returned to the
+    allocator between calls instead of being reserved for a whole fused
+    round."""
+    fused = make_round_fn(cfg)
+    p_bk_deliver, p_bk_process, p_mk_deliver, p_mk_process = fused.pieces
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def a1_fn(st: OverlayState):
+        bk_mbox, n_bk, drop2 = p_bk_deliver(st.bk_dst)
+        return (bk_mbox, n_bk, drop2, st.friends, st.friend_cnt, st.mk_dst,
+                st.boot_dst, st.round, st.makeups, st.breakups,
+                st.mailbox_dropped)
+
+    @functools.partial(jax.jit, donate_argnums=tuple(range(11)))
+    def a2_fn(bk_mbox, n_bk, drop2, friends, cnt, mk_dst, boot_dst, round_,
+              makeups0, breakups0, dropped0, base_key):
+        friends, cnt, mk_em, win_bk = p_bk_process(
+            friends, cnt, bk_mbox, n_bk, drop2, round_, base_key)
+        return (friends, cnt, mk_em, win_bk, drop2, mk_dst, boot_dst,
+                round_, makeups0, breakups0, dropped0)
+
+    @functools.partial(jax.jit, donate_argnums=tuple(range(11)))
+    def b1_fn(friends, cnt, mk_em, win_bk, drop2, mk_dst, boot_dst, round_,
+              makeups0, breakups0, dropped0):
+        mk_mbox, n_mk, drop1, friends, cnt = p_mk_deliver(
+            mk_dst, boot_dst, friends, cnt, win_bk)
+        return (mk_mbox, n_mk, drop1, friends, cnt, mk_em, win_bk, drop2,
+                round_, makeups0, breakups0, dropped0)
+
+    @functools.partial(jax.jit, donate_argnums=tuple(range(12)))
+    def b2_fn(mk_mbox, n_mk, drop1, friends, cnt, mk_em, win_bk, drop2,
+              round_, makeups0, breakups0, dropped0, base_key):
+        return p_mk_process(
+            mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em, win_bk,
+            round_, makeups0, breakups0, dropped0, base_key)
+
+    def round4(st: OverlayState, base_key) -> OverlayState:
+        inter = a1_fn(st)
+        inter = a2_fn(*inter, base_key)
+        inter = b1_fn(*inter)
+        return b2_fn(*inter, base_key)
+
+    return round4
+
+
+def use_split_round(cfg: Config, n_rows: int | None = None) -> bool:
+    """Single-device rounds engine at memory scale (the sharded hook path
+    keeps the fused round: its per-shard slices sit far below the band)."""
+    rows = n_rows if n_rows is not None else cfg.n
+    return rows >= SPLIT_ROUND_MIN_ROWS
 
 
 class OverlayResult(NamedTuple):
@@ -324,7 +476,8 @@ class OverlayResult(NamedTuple):
 
 
 def pending_emissions(st: OverlayState) -> jnp.ndarray:
-    return (st.mk_dst >= 0).sum(dtype=I32) + (st.bk_dst >= 0).sum(dtype=I32)
+    return ((st.mk_dst >= 0).sum(dtype=I32) + (st.bk_dst >= 0).sum(dtype=I32)
+            + (st.boot_dst >= 0).sum(dtype=I32))
 
 
 def quiesced(st: OverlayState) -> jnp.ndarray:

@@ -96,8 +96,16 @@ class OverlayState(NamedTuple):
 
     friends: jnp.ndarray  # int32[n, k]
     friend_cnt: jnp.ndarray  # int32[n]
-    mk_dst: jnp.ndarray  # int32[n, em]  makeup emissions (dst per slot; src=row)
-    bk_dst: jnp.ndarray  # int32[n, eb]  breakup emissions
+    # Slot-major (slots, n) with slots = the mailbox cap EXACTLY: the node
+    # axis is minormost (tile-friendly) and the slot count is a multiple
+    # of the T(8,128) sublane tile -- (10, 1e8) padded 1.6x to 5.96 GB and
+    # broke the 100M single-chip build (round 4).  Bootstrap emissions
+    # (one per node per round) live in their own flat vector, delivered
+    # after the reply slots -- the same order the (cap+2)-wide layout
+    # produced.
+    mk_dst: jnp.ndarray  # int32[cap, n]  makeup emissions (dst per slot; src=lane)
+    bk_dst: jnp.ndarray  # int32[cap, n]  breakup emissions
+    boot_dst: jnp.ndarray  # int32[n]  bootstrap makeups (src=lane)
     round: jnp.ndarray  # int32[]
     makeups: jnp.ndarray  # int32[]  cumulative processed (MakeUps)
     breakups: jnp.ndarray  # int32[]  (BreakUps)

@@ -86,7 +86,7 @@ def segment_ranks(sorted_keys: jnp.ndarray) -> jnp.ndarray:
 
 def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
             n: int, cap: int, compact_chunk: int | None = None,
-            src_cols: int | None = None):
+            src_cols: int | None = None, src_mod: int | None = None):
     """Deliver messages into per-destination mailboxes.
 
     Args:
@@ -100,6 +100,8 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
             The chunked path then skips both the caller's n*src_cols-wide
             broadcast materialization (4*n*src_cols bytes; 720 MB at the
             10M-node overlay) and the per-chunk gather from it.
+        src_mod: like src_cols but for SLOT-major flattened (slots, n)
+            matrices -- sender ids derive as flat_index % src_mod.
         compact_chunk: if set (and flat int32 addressing fits,
             (n+1)*cap < 2^31 -- past that the dense 2-D path runs and this
             is silently ignored), compact the valid messages (two-level
@@ -124,15 +126,15 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
     avoids relying on the OOB-drop semantics that were miscompiled there).
     """
     m = dst.shape[0]
-    if src is None and src_cols is None:
+    if src is None and src_cols is None and src_mod is None:
         # Caught here rather than as `int // None` in the derivation below
         # (advisor r3: the non-compact path otherwise raised an opaque
         # TypeError).
-        raise ValueError("deliver: src=None requires src_cols")
+        raise ValueError("deliver: src=None requires src_cols or src_mod")
     if compact_chunk is not None and compact_chunk < m:
         if flat_addressing_fits(n, cap):
             return _deliver_compact(src, dst, valid, n, cap, compact_chunk,
-                                    src_cols=src_cols)
+                                    src_cols=src_cols, src_mod=src_mod)
         # Flat int32 addressing no longer fits: the requested compaction is
         # ignored and the full-length sort + 2-D scatter path below runs
         # (~15x slower per the NOTE).  Without a signal this reads as an
@@ -147,7 +149,9 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
                 "reduce -mailbox-cap or shard the node axis",
                 stacklevel=2)
     if src is None:
-        src = jnp.arange(m, dtype=jnp.int32) // src_cols
+        src = (jnp.arange(m, dtype=jnp.int32) % src_mod
+               if src_cols is None
+               else jnp.arange(m, dtype=jnp.int32) // src_cols)
     key = jnp.where(valid, dst, n).astype(jnp.int32)
     sd, ss = jax.lax.sort((key, src.astype(jnp.int32)), num_keys=1,
                           is_stable=True)
@@ -213,7 +217,8 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
 
 
 def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
-                           src_cols=None, carry=None):
+                           src_cols=None, src_mod=None, carry=None,
+                           rank_major=False):
     """Chunked-compacted delivery on a prepacked key in [0, nk) with nk
     the invalid sentinel -- the ONE chunked work-horse behind
     _deliver_compact (key = dst), deliver_pair (key = typ*n + dst) and
@@ -224,7 +229,15 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
     chained calls continue per-node ranks exactly like the chunk
     continuation within one call.  Returns the flat (nk*cap + 1) mailbox
     incl. trash cell, the TOTAL-arrivals count array (nk + 1), and the
-    drop count."""
+    drop count.
+
+    `rank_major` packs cell (key, rank) at rank*nk + key instead of
+    key*cap + rank: mailbox slot r is then the CONTIGUOUS range
+    [r*nk, (r+1)*nk) -- consumers can dynamic_slice a whole slot without
+    ever materializing an (nk, cap) 2-D array, whose narrow minor dim
+    TPU tile layouts pad to 128 lanes (observed 16x: s32[1e8, 8] tiled
+    T(8,128) would be a 51 GB allocation -- the round-4 100M overlay
+    compile OOM).  Same cells, same values, different addressing."""
     m = valid.shape[0]
     total = valid.sum(dtype=jnp.int32)
     chunks = (total + chunk - 1) // chunk
@@ -235,17 +248,22 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
         hit = jnp.zeros((m,), bool).at[idx].set(True, mode="drop")
         remaining = remaining & ~hit
         v = idx < m
-        if src_cols is None:
-            s = src.at[idx].get(mode="fill", fill_value=-1)
-        else:
+        if src_cols is not None:
             s = jnp.where(v, idx // src_cols, -1)
+        elif src_mod is not None:
+            s = jnp.where(v, idx % src_mod, -1)
+        else:
+            s = src.at[idx].get(mode="fill", fill_value=-1)
         key = key_full.at[idx].get(mode="fill", fill_value=nk)
         key = jnp.where(v, key, nk)
         sd, ss = jax.lax.sort((key, s.astype(jnp.int32)), num_keys=1,
                               is_stable=True)
         rank = segment_ranks(sd) + count[jnp.minimum(sd, nk)]
         ok = (sd < nk) & (rank < cap)
-        flat = jnp.where(ok, sd * cap + rank, nk * cap)
+        if rank_major:
+            flat = jnp.where(ok, rank * nk + sd, nk * cap)
+        else:
+            flat = jnp.where(ok, sd * cap + rank, nk * cap)
         mbox = mbox.at[flat].set(jnp.where(ok, ss, -1))
         count = count.at[jnp.where(sd < nk, sd, nk)].add(1)
         dropped = dropped + ((sd < nk) & (rank >= cap)).sum(dtype=jnp.int32)
@@ -260,41 +278,58 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
     return mbox, count, dropped
 
 
-def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int):
-    """Per-COLUMN chunked delivery of an (n_rows, cols) emission matrix
-    whose sender id is the row index.
+def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int,
+                    flat: bool = False, carry=None):
+    """Per-SLOT chunked delivery of a (slots, n) emission matrix whose
+    sender id is the lane (column) index.
 
-    The flattened form scans the full n_rows*cols mask per compaction
-    chunk (~76 ms/chunk at the 10M-node overlay's 180M lanes, 84% of the
-    round); scanning per COLUMN costs n_rows lanes per chunk instead --
-    the same entries at ~1/cols the scan width -- and the sender id is
-    the chunk index itself (no src gather, no broadcast).  Arrival order
-    is therefore COLUMN-major (slot, then node): a deterministic
-    re-choice of the engine's canonical mailbox order, not a fidelity
-    change -- the reference's own arrival order is goroutine-racy
-    (simulator.go:51-54), so any fixed order is equally faithful; the
-    golden transcripts pin the one chosen here.  Per-node ranks continue
-    across columns and chunks via the total-arrivals counter, and
-    columns with zero emissions cost one n_rows-wide popcount.
+    The flattened form scans the full slots*n mask per compaction chunk
+    (~76 ms/chunk at the 10M-node overlay's 180M lanes, 84% of the
+    round); scanning per SLOT row costs n lanes per chunk instead -- the
+    same entries at ~1/slots the scan width -- and the sender id is the
+    lane index itself (no src gather, no broadcast).  Arrival order is
+    therefore SLOT-major (slot, then node): a deterministic re-choice of
+    the engine's canonical mailbox order, not a fidelity change -- the
+    reference's own arrival order is goroutine-racy (simulator.go:51-54),
+    so any fixed order is equally faithful; the golden trajectory pins
+    the one chosen here.  Per-node ranks continue across slots and
+    chunks via the total-arrivals counter, and slots with zero emissions
+    cost one n-wide popcount.
 
-    Returns (mbox int32[n, cap], dropped)."""
-    cols = dst_mat.shape[1]
-    carry = None
-    for c in range(cols):
-        dcol = dst_mat[:, c]
-        # src_cols=1: the sender id is the lane index itself; the chained
-        # carry continues per-node ranks across columns exactly like the
-        # chunk continuation within one call.
-        carry = _deliver_compact_keyed(None, dcol, dcol >= 0, n, cap,
-                                       chunk, src_cols=1, carry=carry)
-    mbox, _, dropped = carry
+    With `flat` (the large-n path), returns the RANK-MAJOR flat mailbox
+    (see _deliver_compact_keyed: mailbox slot r is the contiguous range
+    [r*n, (r+1)*n)) plus the max per-node load, never materializing the
+    16x-padded (n, cap) tile layout: (mbox_flat int32[n*cap + 1],
+    max_load int32[], dropped).  Otherwise (mbox int32[n, cap], dropped).
+    Cell contents are identical either way.
+
+    `dst_mat` may be a tuple of matrices: their slot rows chain in order
+    through the same carry (the overlay's reply buffers followed by the
+    bootstrap vector reshaped (1, n)).  `carry` optionally supplies the
+    initial (mbox, count, dropped) -- the overlay passes allocation-
+    sequenced buffers so consecutive deliveries can share memory."""
+    mats = dst_mat if isinstance(dst_mat, (tuple, list)) else (dst_mat,)
+    for mat in mats:
+        for c in range(mat.shape[0]):
+            dcol = mat[c]
+            # src_cols=1: the sender id is the lane index itself; the
+            # chained carry continues per-node ranks across slots exactly
+            # like the chunk continuation within one call.
+            carry = _deliver_compact_keyed(None, dcol, dcol >= 0, n, cap,
+                                           chunk, src_cols=1, carry=carry,
+                                           rank_major=flat)
+    mbox, count, dropped = carry
+    if flat:
+        return mbox, jnp.minimum(count[:n].max(initial=0), cap), dropped
     return mbox[:n * cap].reshape(n, cap), dropped
 
 
-def _deliver_compact(src, dst, valid, n, cap, chunk, src_cols=None):
+def _deliver_compact(src, dst, valid, n, cap, chunk, src_cols=None,
+                     src_mod=None):
     """Chunked-compacted deliver (see deliver's compact_chunk)."""
     key_full = jnp.where(valid, dst, n).astype(jnp.int32)
     mbox, count, dropped = _deliver_compact_keyed(
-        src, key_full, valid, n, cap, chunk, src_cols=src_cols)
+        src, key_full, valid, n, cap, chunk, src_cols=src_cols,
+        src_mod=src_mod)
     return (mbox[:n * cap].reshape(n, cap),
             jnp.minimum(count[:n], cap), dropped)

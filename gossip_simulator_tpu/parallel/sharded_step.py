@@ -48,7 +48,7 @@ def sim_state_specs() -> SimState:
 def overlay_state_specs() -> OverlayState:
     return OverlayState(
         friends=P(AXIS, None), friend_cnt=P(AXIS),
-        mk_dst=P(AXIS, None), bk_dst=P(AXIS, None),
+        mk_dst=P(None, AXIS), bk_dst=P(None, AXIS), boot_dst=P(AXIS),
         round=P(), makeups=P(), breakups=P(),
         win_makeups=P(), win_breakups=P(), mailbox_dropped=P(),
     )

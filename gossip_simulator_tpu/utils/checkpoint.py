@@ -199,10 +199,10 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                 f"{dw} windows over {n_shards} shard(s))")
     else:
         cap_mb = cfg.mailbox_cap_for(n_local)
-        if int(tree["mk_dst"].shape[1]) != cap_mb + 2:
+        if int(tree["mk_dst"].shape[0]) != cap_mb:
             raise ValueError(
-                f"checkpoint emission buffers are {int(tree['mk_dst'].shape[1])}"
-                f" wide but this config's mailbox cap gives {cap_mb + 2}; "
+                f"checkpoint emission buffers are {int(tree['mk_dst'].shape[0])}"
+                f" wide but this config's mailbox cap gives {cap_mb}; "
                 "restore with the snapshot's -mailbox-cap / device count")
     return tree
 

@@ -1,6 +1,7 @@
 """Checkpoint save/load (utils/checkpoint.py) and resume on the jax backend."""
 
 import numpy as np
+import pytest
 
 from gossip_simulator_tpu.backends.jax_backend import JaxStepper
 from gossip_simulator_tpu.config import Config
@@ -142,8 +143,6 @@ def test_sharded_resume_repacks_mail_geometry(tmp_path):
 
 
 def test_sharded_resume_shard_count_mismatch_rejected(tmp_path):
-    import pytest
-
     cfg = Config(n=4000, backend="sharded", graph="kout", fanout=6, seed=3,
                  progress=False).validate()
     s = _sharded(cfg)
@@ -195,8 +194,6 @@ def test_resume_engine_mismatch_rejected(tmp_path):
     s2 = JaxStepper(cfg_event)
     s2.init()
     tree, _ = checkpoint.load(path)
-    import pytest
-
     with pytest.raises(ValueError, match="ring engine"):
         s2.load_state_pytree(tree)
 
@@ -227,9 +224,6 @@ def _stepper(cfg):
         s = JaxStepper(cfg)
     s.init()
     return s
-
-
-import pytest
 
 
 @pytest.mark.parametrize("backend", ["jax", "sharded"])

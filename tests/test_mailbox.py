@@ -72,25 +72,27 @@ def test_deliver_compact_chunk_bit_identical():
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_auto_mailbox_cap_decliff():
-    """Past the flat-int32-addressing boundary (n ~ 1.34e8 at cap 16) the
-    AUTO mailbox cap shrinks to 8 so the compact delivery path keeps
-    engaging instead of silently taking the ~15x dense fallback (VERDICT r2
-    weak #6); an explicit -mailbox-cap still wins and gets the one-time
-    warning from deliver when it forces the dense path."""
-    from gossip_simulator_tpu.config import Config
+def test_auto_mailbox_cap_size_bands():
+    """The AUTO mailbox cap shrinks 16 -> 8 at the MEMORY band (3.2e7 rows:
+    the rounds overlay's emission buffers alone would be 13.6 GB at cap 16
+    / n=1e8), which also keeps flat int32 addressing (the compact delivery
+    path; the dense fallback is ~15x) to n ~ 2.7e8.  Every measured /
+    golden-pinned config (<= 10M rows) keeps cap 16; an explicit
+    -mailbox-cap still wins and gets the one-time warning from deliver
+    when it forces the dense path."""
+    from gossip_simulator_tpu.config import MAILBOX_CAP_MEMORY_BAND, Config
     from gossip_simulator_tpu.ops.mailbox import flat_addressing_fits
 
-    below = Config(n=134_000_000)
-    above = Config(n=140_000_000)
-    assert below.mailbox_cap_resolved == 16
-    assert flat_addressing_fits(below.n, 16)
-    assert not flat_addressing_fits(above.n, 16)
-    assert above.mailbox_cap_resolved == 8
-    assert flat_addressing_fits(above.n, above.mailbox_cap_resolved)
-    # Flat addressing (hence the compact path) now holds to ~2.7e8.
+    assert Config(n=10_000_000).mailbox_cap_resolved == 16
+    assert Config(n=MAILBOX_CAP_MEMORY_BAND - 1).mailbox_cap_resolved == 16
+    assert Config(n=MAILBOX_CAP_MEMORY_BAND).mailbox_cap_resolved == 8
+    assert Config(n=100_000_000).mailbox_cap_resolved == 8
+    assert Config(n=140_000_000).mailbox_cap_resolved == 8
+    # The memory band sits below the addressing cliff, so auto caps always
+    # keep the compact path: flat addressing holds to ~2.7e8 at cap 8.
     assert flat_addressing_fits(268_000_000, 8)
     assert not flat_addressing_fits(269_000_000, 8)
+    assert not flat_addressing_fits(140_000_000, 16)
     # Explicit cap is honored verbatim (dense fallback + warning territory).
     assert Config(n=140_000_000, mailbox_cap=16).mailbox_cap_resolved == 16
 
@@ -136,47 +138,50 @@ def test_deliver_pair_matches_two_delivers():
             assert int(d0) + int(d1) == int(dp)
 
 
-def test_auto_mailbox_cap_decliff_stacked():
-    """Stacked consumers (the ticks overlay's deliver_pair [2n, cap]
-    addressing) shrink the auto cap at HALF the plain boundary (~6.7e7);
-    plain deliver() surfaces -- incl. phase-2 delivery in a ticks-mode
-    run -- keep the full-boundary cap (advisor r3: the shrink is keyed on
-    the consumer, not on overlay_mode)."""
-    from gossip_simulator_tpu.config import Config
+def test_auto_mailbox_cap_stacked_backstop():
+    """The stacked-addressing shrink (deliver_pair's [2n, cap] flat
+    layout, ~6.7e7 at cap 16) sits ABOVE the memory band, so auto caps
+    reach it already at 8 -- the stacked branch is a backstop kept
+    exactly as the delivery gate consults it (advisor r3: keyed on the
+    consumer, not on overlay_mode).  Below the band, stacked and plain
+    agree at 16; an explicit cap bypasses both bands but not the
+    delivery-path gates."""
+    from gossip_simulator_tpu.config import MAILBOX_CAP_MEMORY_BAND, Config
     from gossip_simulator_tpu.ops.mailbox import flat_addressing_fits
 
-    def cap(n, mode, stacked):
-        return Config(n=n, overlay_mode=mode).mailbox_cap_for(
-            n, stacked=stacked)
+    def cap(n, stacked):
+        return Config(n=n).mailbox_cap_for(n, stacked=stacked)
 
-    assert cap(67_000_000, "ticks", True) == 16
-    assert cap(68_000_000, "ticks", True) == 8  # stacked 16 would overflow
-    assert cap(68_000_000, "rounds", False) == 16
-    # Phase-2 delivery in a ticks run is a PLAIN surface: no early shrink.
-    assert cap(68_000_000, "ticks", False) == 16
-    assert cap(134_000_000, "ticks", True) == 8
+    below = MAILBOX_CAP_MEMORY_BAND - 1
+    assert cap(below, True) == cap(below, False) == 16
+    assert flat_addressing_fits(2 * below + 1, 16)  # stacked 16 still fits
+    assert cap(68_000_000, True) == cap(68_000_000, False) == 8
     # The shrunk cap keeps the STACKED addressing flat to ~1.34e8.
     assert flat_addressing_fits(2 * 134_000_000 + 1, 8)
+    assert not flat_addressing_fits(2 * 135_000_000 + 1, 8)
 
 
 def test_deliver_columns_matches_reference():
-    """deliver_columns: column-major arrival order (slot, then node),
-    per-node ranks continuing across columns/chunks, overflow counted.
-    Checked against a direct numpy mailbox fill."""
+    """deliver_columns: slot-major arrival order (emission slot, then
+    node), per-node ranks continuing across slots/chunks, overflow
+    counted.  The matrix is (slots, n) with the sender as the lane index
+    (the emission buffers' slot-major layout).  Checked against a direct
+    numpy mailbox fill, on both the 2-D and the flat rank-major returns
+    (identical cells, different addressing)."""
     from gossip_simulator_tpu.ops.mailbox import deliver_columns
 
     rng = np.random.default_rng(11)
-    n, cols, cap = 500, 7, 3
+    n, slots, cap = 500, 7, 3
     for density in (0.05, 0.4, 1.0):
-        mat = np.where(rng.random((n, cols)) < density,
-                       rng.integers(0, n, (n, cols)), -1).astype(np.int32)
+        mat = np.where(rng.random((slots, n)) < density,
+                       rng.integers(0, n, (slots, n)), -1).astype(np.int32)
         mbox, dropped = deliver_columns(jnp.asarray(mat), n, cap, chunk=64)
         want = np.full((n, cap), -1, np.int32)
         cnt = np.zeros(n, np.int64)
         drops = 0
-        for c in range(cols):
+        for c in range(slots):
             for r in range(n):
-                d = mat[r, c]
+                d = mat[c, r]
                 if d < 0:
                     continue
                 if cnt[d] < cap:
@@ -186,6 +191,13 @@ def test_deliver_columns_matches_reference():
                 cnt[d] += 1
         np.testing.assert_array_equal(np.asarray(mbox), want)
         assert int(dropped) == drops
+        # Flat rank-major return: same cells at rank*n + node.
+        fmbox, maxload, fdropped = deliver_columns(
+            jnp.asarray(mat), n, cap, chunk=64, flat=True)
+        got = np.asarray(fmbox)[:n * cap].reshape(cap, n).T
+        np.testing.assert_array_equal(got, want)
+        assert int(fdropped) == drops
+        assert int(maxload) == min(int(cnt.max(initial=0)), cap)
 
 
 def test_deliver_derived_src_matches_explicit():
@@ -235,3 +247,23 @@ def test_column_delivery_band_small_n_golden(monkeypatch):
     assert res.stats.total_message == 10160
     assert res.stats.total_crashed == 14
     assert res.stats.mailbox_dropped == 0
+
+
+def test_split_round_identical_to_fused(monkeypatch):
+    """The two-call split round (overlay.make_split_round_fn, the
+    n >= 32M memory path) must be bit-identical to the fused round: both
+    run the same phase_a/phase_b closures, only the jit boundary moves."""
+    import gossip_simulator_tpu.models.overlay as ov
+    from gossip_simulator_tpu.config import Config
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    cfg = Config(n=3000, graph="overlay", overlay_mode="rounds", fanout=5,
+                 seed=9, backend="jax", progress=False,
+                 coverage_target=0.9).validate()
+    fused = run_simulation(cfg, printer=ProgressPrinter(False))
+    monkeypatch.setattr(ov, "SPLIT_ROUND_MIN_ROWS", 0)
+    split = run_simulation(cfg, printer=ProgressPrinter(False))
+    assert split.stats == fused.stats
+    assert split.stabilize_ms == fused.stabilize_ms
+    assert split.overlay_windows == fused.overlay_windows
