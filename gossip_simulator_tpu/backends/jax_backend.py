@@ -84,16 +84,36 @@ class JaxStepper(Stepper):
         self._orun = None  # lazy: compiled only on the fast path
         self.state = None
 
+    def _quiesced_jit(self):
+        """Jitted quiescence predicate: the eager form materializes the
+        (cap, n) >= 0 emission masks (~1.7 GB at n=1e8) before reducing;
+        fused, the reductions never allocate them."""
+        if getattr(self, "_oq", None) is None:
+            self._oq = jax.jit(self._omod.quiesced)
+        return self._oq
+
+    def _advance_overlay(self) -> None:
+        """One overlay round.  In split mode the state is handed over in
+        a popped box so no frame here retains the old state while the
+        round's serialized calls run (overlay.make_split_round_fn's
+        memory contract)."""
+        if getattr(self, "_osplit", False):
+            box = [self.ostate]
+            self.ostate = None
+            self.ostate = self._oround(box, self.key)
+        else:
+            self.ostate = self._oround(self.ostate, self.key)
+
     def overlay_window(self) -> tuple[int, int, bool]:
         if self._overlay_done:
             return 0, 0, True
-        self.ostate = self._oround(self.ostate, self.key)
+        self._advance_overlay()
         self._overlay_rounds += 1
         faithful = self._faithful_overlay
         tick = self.ostate.tick if faithful else 0
         mk, bk, q, tick = jax.device_get(
             (self.ostate.win_makeups, self.ostate.win_breakups,
-             self._omod.quiesced(self.ostate), tick))
+             self._quiesced_jit()(self.ostate), tick))
         # True simulated ms from the tick clock in faithful mode; the
         # rounds engine only estimates rounds x mean_delay.
         self._phase1_ms = (float(tick) if faithful
@@ -121,12 +141,13 @@ class JaxStepper(Stepper):
             # re-create the OOM; run the host loop instead -- a round is
             # seconds of device work at this n, so the per-round
             # dispatch + quiescence sync is noise.
+            oq = self._quiesced_jit()
             q = False
             while self._overlay_rounds < max_windows:
-                self.ostate = self._oround(self.ostate, self.key)
+                self._advance_overlay()
                 self._overlay_rounds += 1
                 self._phase1_ms = self._overlay_rounds * self._mean_delay
-                q = bool(jax.device_get(self._omod.quiesced(self.ostate)))
+                q = bool(jax.device_get(oq(self.ostate)))
                 if q:
                     break
             if q:

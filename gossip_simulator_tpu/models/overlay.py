@@ -85,12 +85,19 @@ def delivery_chunk(cfg: Config, n_rows: int) -> int:
     131k: 13.2s, 65k: 9.6s, 32k: 11.4s at n=1e6 -- narrow chunks win
     because per-chunk sort/scatter width dominates the extra
     first_true_indices passes of the bootstrap burst); -compact-chunk
-    overrides.  Used by the ROUNDS engine (and its sharded variant); the
-    tick-faithful engine's slot drain has its own scaling
+    overrides.  Above the n/128 knee (~8.4M rows) the chunk scales as
+    n/128 (to 1M): each chunk pays an n-wide compaction scan, so a fixed
+    64k chunk is O(n^2/chunk) on burst rows -- ~1526 full-1e8 scans per
+    bootstrap row at the 100M build (measured ~87-215 s/round r4;
+    n-scaling cuts the scan count 16x).  Chunk size never changes results (ascending
+    ranges + rank continuation are bit-identical at any chunk).  Used by
+    the ROUNDS engine (and its sharded variant); the tick-faithful
+    engine's slot drain has its own scaling
     (overlay_ticks.ticks_delivery_chunk -- its per-chunk cost is
     scatter-floor-bound at GB-scale targets, favoring fat chunks)."""
-    return cfg.compact_chunk if cfg.compact_chunk > 0 \
-        else min(max(4096, n_rows), 65536)
+    if cfg.compact_chunk > 0:
+        return cfg.compact_chunk
+    return min(n_rows, max(65_536, n_rows // 128), 1_048_576)
 
 
 def _col_onehot(cols, k: int):
@@ -263,8 +270,10 @@ def make_round_fn(cfg: Config,
 
     def _slot(mbox, r):
         """Mailbox slot r for every node: contiguous dynamic_slice on the
-        flat rank-major layout, column read on the 2-D one."""
-        if flat_mbox:
+        flat rank-major layout, column read on the 2-D one.  Keyed on the
+        array itself (ndim), not the size band: the split round's hosted
+        delivery hands the pieces a flat mailbox at ANY n."""
+        if mbox.ndim == 1:
             return jax.lax.dynamic_slice(mbox, (r * n,), (n,))
         return mbox[:, r]
 
@@ -409,61 +418,102 @@ SPLIT_ROUND_MIN_ROWS = 32_000_000
 
 
 def make_split_round_fn(cfg: Config):
-    """One overlay round as four jitted calls (see SPLIT_ROUND_MIN_ROWS).
-    Bit-identical to the fused round_fn -- both compose the SAME four
-    piece closures; only the jit boundaries move.  Every call donates all
-    its array arguments, so each phase's dead buffers (bk_dst after its
-    delivery, the bk mailbox after breakup processing, mk_dst/boot after
-    the mk delivery, the mk mailbox at the end) are returned to the
-    allocator between calls instead of being reserved for a whole fused
-    round."""
+    """One overlay round as a HOST-driven sequence of bounded device
+    calls (see SPLIT_ROUND_MIN_ROWS).  Bit-identical to the fused
+    round_fn: the two slot-processing phases jit the SAME piece closures,
+    and the two deliveries run ops.mailbox.make_hosted_column_delivery --
+    the same chunk body as deliver_columns, with the chunk loop split
+    across watchdog-bounded calls (one fused burst delivery is > the
+    ~10 s axon kill line at n=1e8).  Every call donates its array
+    arguments and the driver drops dead references + fences between
+    calls, so each phase's multi-GB buffers are retired before the next
+    arena is allocated (a fused round reserved everything at once and
+    peaked at 19.5 GB on the 15.75 GB chip)."""
+    from gossip_simulator_tpu.ops.mailbox import make_hosted_column_delivery
+
     fused = make_round_fn(cfg)
-    p_bk_deliver, p_bk_process, p_mk_deliver, p_mk_process = fused.pieces
+    _, p_bk_process, _, p_mk_process = fused.pieces
+    n = cfg.n
+    cap = cfg.mailbox_cap_for(n)
+    hosted_deliver = make_hosted_column_delivery(n, cap,
+                                                 delivery_chunk(cfg, n))
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def a1_fn(st: OverlayState):
-        bk_mbox, n_bk, drop2 = p_bk_deliver(st.bk_dst)
-        return (bk_mbox, n_bk, drop2, st.friends, st.friend_cnt, st.mk_dst,
-                st.boot_dst, st.round, st.makeups, st.breakups,
-                st.mailbox_dropped)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def a2_fn(friends, cnt, bk_mbox, n_bk, drop2, round_, base_key):
+        return p_bk_process(friends, cnt, bk_mbox, n_bk, drop2, round_,
+                            base_key)
 
-    @functools.partial(jax.jit, donate_argnums=tuple(range(11)))
-    def a2_fn(bk_mbox, n_bk, drop2, friends, cnt, mk_dst, boot_dst, round_,
-              makeups0, breakups0, dropped0, base_key):
-        friends, cnt, mk_em, win_bk = p_bk_process(
-            friends, cnt, bk_mbox, n_bk, drop2, round_, base_key)
-        return (friends, cnt, mk_em, win_bk, drop2, mk_dst, boot_dst,
-                round_, makeups0, breakups0, dropped0)
-
-    @functools.partial(jax.jit, donate_argnums=tuple(range(11)))
-    def b1_fn(friends, cnt, mk_em, win_bk, drop2, mk_dst, boot_dst, round_,
-              makeups0, breakups0, dropped0):
-        mk_mbox, n_mk, drop1, friends, cnt = p_mk_deliver(
-            mk_dst, boot_dst, friends, cnt, win_bk)
-        return (mk_mbox, n_mk, drop1, friends, cnt, mk_em, win_bk, drop2,
-                round_, makeups0, breakups0, dropped0)
-
-    @functools.partial(jax.jit, donate_argnums=tuple(range(12)))
-    def b2_fn(mk_mbox, n_mk, drop1, friends, cnt, mk_em, win_bk, drop2,
+    @functools.partial(jax.jit, donate_argnums=tuple(range(8)))
+    def b2_fn(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em, win_bk,
               round_, makeups0, breakups0, dropped0, base_key):
-        return p_mk_process(
-            mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em, win_bk,
-            round_, makeups0, breakups0, dropped0, base_key)
+        return p_mk_process(mk_mbox, n_mk, drop1, drop2, friends, cnt,
+                            mk_em, win_bk, round_, makeups0, breakups0,
+                            dropped0, base_key)
 
-    def round4(st: OverlayState, base_key) -> OverlayState:
-        inter = a1_fn(st)
-        inter = a2_fn(*inter, base_key)
-        inter = b1_fn(*inter)
-        return b2_fn(*inter, base_key)
+    fence_jit = jax.jit(lambda x: x + 1)
+    reshape_boot = jax.jit(lambda b: b[None, :])
+
+    def fence():
+        """Full host<->worker round trip.  On the axon platform,
+        block_until_ready alone does not reliably get the previous call's
+        donated/dead buffers retired before the next call's arena is
+        allocated -- probed at n=1e8 (2026-07-31): the identical call
+        sequence wedges the worker with RESOURCE_EXHAUSTED without this
+        fence and passes with it, repeatably.  Cost: one tiny cached jit
+        + scalar transfer per phase, noise against seconds of device
+        work at split scale."""
+        jax.device_get(fence_jit(jnp.int32(1)))
+
+    def round4(st: OverlayState | list, base_key) -> OverlayState:
+        # Drop every dead reference before the next call: buffers whose
+        # Python refs linger stay allocated on this platform, and the
+        # arenas pile up into the OOM the split exists to avoid.  Callers
+        # pass the state in a one-element list ("box"): popping it leaves
+        # NO outer frame holding the old state through the round (a
+        # caller's `self.ostate = round(self.ostate, ...)` binding
+        # otherwise keeps all 9.6 GB alive).
+        if isinstance(st, list):
+            st = st.pop()
+        friends, cnt = st.friends, st.friend_cnt
+        mk_dst, boot_dst = st.mk_dst, st.boot_dst
+        bk_dst = st.bk_dst
+        round_, mk0, bk0, d0 = (st.round, st.makeups, st.breakups,
+                                st.mailbox_dropped)
+        del st
+        bk_mbox, n_bk, drop2 = hosted_deliver((bk_dst,))
+        del bk_dst
+        fence()
+        friends, cnt, mk_em, win_bk = a2_fn(friends, cnt, bk_mbox, n_bk,
+                                            drop2, round_, base_key)
+        del bk_mbox
+        jax.block_until_ready(friends)
+        fence()
+        mk_mbox, n_mk, drop1 = hosted_deliver(
+            (mk_dst, reshape_boot(boot_dst)))
+        del mk_dst, boot_dst
+        fence()
+        out = b2_fn(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em,
+                    win_bk, round_, mk0, bk0, d0, base_key)
+        del mk_mbox, friends, cnt, mk_em
+        jax.block_until_ready(out.friends)
+        fence()
+        return out
 
     return round4
 
 
 def use_split_round(cfg: Config, n_rows: int | None = None) -> bool:
     """Single-device rounds engine at memory scale (the sharded hook path
-    keeps the fused round: its per-shard slices sit far below the band)."""
+    keeps the fused round: its per-shard slices sit far below the band).
+    Bounded above by flat int32 mailbox addressing (the hosted delivery
+    is rank-major flat with no dense fallback); past that (~2.7e8 rows
+    at cap 8) the state alone exceeds a single chip's HBM anyway --
+    shard the node axis."""
+    from gossip_simulator_tpu.ops.mailbox import flat_addressing_fits
+
     rows = n_rows if n_rows is not None else cfg.n
-    return rows >= SPLIT_ROUND_MIN_ROWS
+    return (rows >= SPLIT_ROUND_MIN_ROWS
+            and flat_addressing_fits(rows, cfg.mailbox_cap_for(rows)))
 
 
 class OverlayResult(NamedTuple):

@@ -17,6 +17,7 @@ same `deliver` runs per shard after messages are routed with all_to_all
 
 from __future__ import annotations
 
+import functools
 import warnings
 
 import jax
@@ -216,6 +217,29 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
             mbox[n * cap:n2 * cap].reshape(n, cap), dropped)
 
 
+def _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
+                        rank_major):
+    """ONE compaction chunk's delivery: stable sort by key, rank
+    continuation via the total-arrivals counter, capacity-checked flat
+    scatter (trash cell at nk*cap), count/drop updates.  THE shared body
+    behind _deliver_compact_keyed and make_hosted_column_delivery -- the
+    split round's bit-identity with the fused delivery is structural,
+    not a maintained copy.  `key` must already be nk-sentineled for
+    invalid lanes; `s` is the payload (sender ids)."""
+    sd, ss = jax.lax.sort((key, s.astype(jnp.int32)), num_keys=1,
+                          is_stable=True)
+    rank = segment_ranks(sd) + count[jnp.minimum(sd, nk)]
+    ok = (sd < nk) & (rank < cap)
+    if rank_major:
+        flat = jnp.where(ok, rank * nk + sd, nk * cap)
+    else:
+        flat = jnp.where(ok, sd * cap + rank, nk * cap)
+    mbox = mbox.at[flat].set(jnp.where(ok, ss, -1))
+    count = count.at[jnp.where(sd < nk, sd, nk)].add(1)
+    dropped = dropped + ((sd < nk) & (rank >= cap)).sum(dtype=jnp.int32)
+    return mbox, count, dropped
+
+
 def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
                            src_cols=None, src_mod=None, carry=None,
                            rank_major=False):
@@ -256,17 +280,8 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
             s = src.at[idx].get(mode="fill", fill_value=-1)
         key = key_full.at[idx].get(mode="fill", fill_value=nk)
         key = jnp.where(v, key, nk)
-        sd, ss = jax.lax.sort((key, s.astype(jnp.int32)), num_keys=1,
-                              is_stable=True)
-        rank = segment_ranks(sd) + count[jnp.minimum(sd, nk)]
-        ok = (sd < nk) & (rank < cap)
-        if rank_major:
-            flat = jnp.where(ok, rank * nk + sd, nk * cap)
-        else:
-            flat = jnp.where(ok, sd * cap + rank, nk * cap)
-        mbox = mbox.at[flat].set(jnp.where(ok, ss, -1))
-        count = count.at[jnp.where(sd < nk, sd, nk)].add(1)
-        dropped = dropped + ((sd < nk) & (rank >= cap)).sum(dtype=jnp.int32)
+        mbox, count, dropped = _compact_chunk_step(
+            mbox, count, dropped, key, s, nk, cap, rank_major)
         return mbox, count, dropped, remaining
 
     if carry is None:
@@ -322,6 +337,102 @@ def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int,
     if flat:
         return mbox, jnp.minimum(count[:n].max(initial=0), cap), dropped
     return mbox[:n * cap].reshape(n, cap), dropped
+
+
+def make_hosted_column_delivery(n: int, cap: int, chunk: int,
+                                per_call_chunks: int = 256):
+    """deliver_columns(flat=True) as a HOST-driven sequence of bounded
+    device calls -- the memory-scale overlay's delivery (overlay.
+    make_split_round_fn).  One fused delivery of a full emission row is
+    minutes of chunks at n=1e8 (the bootstrap burst is ~1526 64k-chunks)
+    and a single device call past ~10 s gets the axon worker killed
+    (UNAVAILABLE; the calibration note in overlay_ticks.run_call_budget),
+    so the chunk loop runs `per_call_chunks` trips per jitted call with
+    the carry donated across calls.  Rows with zero emissions cost one
+    jitted popcount -- CHEAPER than the fused form's full scan.
+
+    Bit-identical to deliver_columns(..., flat=True): same chunk body,
+    same ascending-index order, same rank continuation (pinned by the
+    split==fused trajectory test).  Returns fn(mats) ->
+    (mbox_flat int32[n*cap + 1] rank-major, max_load, dropped)."""
+    count_valid = jax.jit(lambda d: (d >= 0).sum(dtype=jnp.int32))
+    finish = jax.jit(
+        lambda count: jnp.minimum(count[:n].max(initial=0), cap))
+
+    def _chunk_body(mbox, count, dropped, idx, dcol):
+        v = idx < n
+        s = jnp.where(v, idx, -1)  # sender = lane index (src_cols=1)
+        key = dcol.at[idx].get(mode="fill", fill_value=n)
+        key = jnp.where(v, key, n)
+        return _compact_chunk_step(mbox, count, dropped, key, s, n, cap,
+                                   rank_major=True)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def kstep(mbox, count, dropped, remaining, dcol, trips):
+        def body(i, carry):
+            mbox, count, dropped, remaining = carry
+            idx = first_true_indices(remaining, chunk)
+            hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+            remaining = remaining & ~hit
+            mbox, count, dropped = _chunk_body(mbox, count, dropped, idx,
+                                               dcol)
+            return mbox, count, dropped, remaining
+
+        return jax.lax.fori_loop(0, trips, body,
+                                 (mbox, count, dropped, remaining))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def kstep_dense(mbox, count, dropped, dcol, start, trips):
+        """Fully-valid row (every lane emits -- the bootstrap burst):
+        chunks are plain ascending ranges, no compaction scan at all.
+        Bit-identical to kstep on an all-true mask (first_true_indices
+        of all-true IS the ascending range)."""
+        def body(i, carry):
+            mbox, count, dropped = carry
+            idx = start + i * chunk + jnp.arange(chunk, dtype=jnp.int32)
+            idx = jnp.minimum(idx, n)  # tail: clamp to the n sentinel
+            return _chunk_body(mbox, count, dropped, idx, dcol)
+
+        return jax.lax.fori_loop(0, trips, body, (mbox, count, dropped))
+
+    remaining_jit = jax.jit(lambda d: d >= 0)
+
+    def run(mats):
+        mbox = jnp.full((n * cap + 1,), -1, dtype=jnp.int32)
+        count = jnp.zeros((n + 1,), dtype=jnp.int32)
+        dropped = jnp.zeros((), jnp.int32)
+        for mat in mats:
+            for c in range(mat.shape[0]):
+                dcol = mat[c]
+                total = int(jax.device_get(count_valid(dcol)))
+                chunks = -(-total // chunk)
+                if chunks == 0:
+                    continue
+                if total == int(dcol.shape[0]):
+                    # Fully-valid row (the bootstrap burst): ascending
+                    # ranges, no compaction scans.
+                    done = 0
+                    while done < chunks:
+                        t = min(per_call_chunks, chunks - done)
+                        mbox, count, dropped = kstep_dense(
+                            mbox, count, dropped, dcol,
+                            jnp.int32(done * chunk), jnp.int32(t))
+                        jax.block_until_ready(mbox)
+                        done += t
+                    continue
+                remaining = remaining_jit(dcol)
+                done = 0
+                while done < chunks:
+                    t = min(per_call_chunks, chunks - done)
+                    mbox, count, dropped, remaining = kstep(
+                        mbox, count, dropped, remaining, dcol,
+                        jnp.int32(t))
+                    jax.block_until_ready(mbox)
+                    done += t
+                del remaining
+        return mbox, finish(count), dropped
+
+    return run
 
 
 def _deliver_compact(src, dst, valid, n, cap, chunk, src_cols=None,

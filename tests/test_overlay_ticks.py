@@ -223,9 +223,16 @@ def test_phase1_sizing_functions():
     assert tdc(Config(n=100_000_000), 100_000_000) == 2_097_152
     assert tdc(Config(n=10_000_000, compact_chunk=65_536),
                10_000_000) == 65_536
-    # Rounds delivery chunk unchanged at its swept 64k optimum.
+    # Rounds delivery chunk: swept 64k optimum up to the n/128 knee at
+    # ~8.4M rows, then n-scaled (each chunk pays an n-wide compaction
+    # scan) to a 1M cap.
+    assert overlay.delivery_chunk(Config(n=1_000_000), 1_000_000) == 65_536
     assert overlay.delivery_chunk(Config(n=10_000_000),
-                                  10_000_000) == 65_536
+                                  10_000_000) == 78_125
+    assert overlay.delivery_chunk(Config(n=100_000_000),
+                                  100_000_000) == 781_250
+    assert overlay.delivery_chunk(Config(n=300_000_000),
+                                  300_000_000) == 1_048_576
 
 
 def test_adaptive_drain_width_identical(monkeypatch):
