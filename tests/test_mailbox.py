@@ -267,3 +267,40 @@ def test_split_round_identical_to_fused(monkeypatch):
     assert split.stats == fused.stats
     assert split.stabilize_ms == fused.stabilize_ms
     assert split.overlay_windows == fused.overlay_windows
+
+
+def test_hosted_column_delivery_matches_fused():
+    """make_hosted_column_delivery (the split round's watchdog-bounded
+    delivery driver) must reproduce deliver_columns(flat=True) exactly
+    across multi-chunk rows, multi-CALL chunk groups (per_call_chunks=1),
+    the dense fast path (a fully-valid row), empty rows, and over-cap
+    drops -- the bit-identity the 100M split round rests on."""
+    from gossip_simulator_tpu.ops.mailbox import (
+        deliver_columns, make_hosted_column_delivery)
+
+    rng = np.random.default_rng(17)
+    n, cap, chunk = 700, 3, 64
+    rows = [
+        np.where(rng.random(n) < 0.3, rng.integers(0, n, n), -1),  # sparse
+        rng.integers(0, n, n),                                     # DENSE
+        np.full(n, -1),                                            # empty
+        np.where(rng.random(n) < 0.9, rng.integers(0, n // 10, n), -1),
+    ]
+    mat = jnp.asarray(np.stack(rows).astype(np.int32))
+    want_mbox, want_load, want_drop = deliver_columns(
+        mat, n, cap, chunk, flat=True)
+    for per_call in (1, 3, 1000):
+        run = make_hosted_column_delivery(n, cap, chunk,
+                                          per_call_chunks=per_call)
+        got_mbox, got_load, got_drop = run((mat,))
+        np.testing.assert_array_equal(np.asarray(got_mbox),
+                                      np.asarray(want_mbox))
+        assert int(got_load) == int(want_load)
+        assert int(got_drop) == int(want_drop)
+    # Tuple chaining: splitting the matrix into two mats is identical.
+    run = make_hosted_column_delivery(n, cap, chunk, per_call_chunks=2)
+    got_mbox, got_load, got_drop = run((mat[:2], mat[2:]))
+    np.testing.assert_array_equal(np.asarray(got_mbox),
+                                  np.asarray(want_mbox))
+    assert (int(got_load), int(got_drop)) == (int(want_load),
+                                              int(want_drop))
