@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -144,7 +145,6 @@ def headline(n: int | None, seed: int) -> dict:
     # * C++ discrete-event loop ("cpp"): the strongest single-core native
     #   implementation of the same semantics -- the honest perf bar.
     nat = _bench_oracle(cfg.replace(n=min(n, 100_000), backend="native"))
-    import os
     import shutil
 
     from gossip_simulator_tpu.backends import cpp as cpp_mod
@@ -218,13 +218,18 @@ def capture_sharded_1chip(detail: dict, seed: int) -> None:
     base = Config(n=10_000_000, fanout=3, graph="kout", backend="sharded",
                   seed=seed, crashrate=0.001, coverage_target=0.90,
                   max_rounds=3000, pallas=True, progress=False).validate()
+    # The 99% twins run crashrate 0.0 from round 5 on (same rationale as
+    # the 100M north-star row: the reference's own crash default truncates
+    # to 0, and it is the duplicate-suppression gate); sharded_10m keeps
+    # 0.001 for cross-round comparability.
     for name, cfg in (
         ("sharded_10m", base),
         ("sharded_50m_99pct", base.replace(
-            n=50_000_000, fanout=6, coverage_target=0.99).validate()),
+            n=50_000_000, fanout=6, coverage_target=0.99,
+            crashrate=0.0).validate()),
         ("jax_50m_99pct", base.replace(
             n=50_000_000, fanout=6, coverage_target=0.99,
-            backend="jax").validate()),
+            crashrate=0.0, backend="jax").validate()),
     ):
         try:
             detail[name] = _bench_backend(cfg)
@@ -285,11 +290,26 @@ def capture_100m(detail: dict, seed: int, headline_n: int) -> None:
             detail["jax_100m"] = _bench_jax(base)
         except Exception as e:  # record, don't kill the record
             detail["jax_100m"] = {"error": repr(e)}
+    # NORTH-STAR row: crashrate 0.0 from round 5 on -- the reference's own
+    # default crashrate 0.001 IS 0 under its 1%-resolution Bernoulli
+    # (simulator.go:180), and crash_p == 0 is the soundness gate for
+    # duplicate suppression (config.dup_suppress).  Round <= 4 rows ran
+    # the exact-float 0.001 (35.4s at r4; the crashrate change itself is
+    # ~0.1s -- the off-twin below isolates the suppression effect).
+    star = base.replace(fanout=6, coverage_target=0.99,
+                        crashrate=0.0).validate()
     try:
-        detail["jax_100m_99pct"] = _bench_jax(base.replace(
-            fanout=6, coverage_target=0.99).validate())
+        detail["jax_100m_99pct"] = _bench_jax(star)
     except Exception as e:
         detail["jax_100m_99pct"] = {"error": repr(e)}
+    try:
+        # A/B twin: identical physics with suppression forced off (same
+        # per-window observables by construction; see the dup-suppress
+        # tests) -- records the suppression speedup in the driver record.
+        detail["jax_100m_99pct_nosuppress"] = _bench_backend(
+            star.replace(dup_suppress="off").validate())
+    except Exception as e:
+        detail["jax_100m_99pct_nosuppress"] = {"error": repr(e)}
 
 
 def _pallas_validation() -> dict:
@@ -297,7 +317,6 @@ def _pallas_validation() -> dict:
     would open a second TPU client while this one is live -- concurrent
     clients can crash the worker) and write the artifact."""
     import importlib.util
-    import os
 
     here = os.path.dirname(os.path.abspath(__file__))
     try:
@@ -428,8 +447,6 @@ def main() -> int:
             # Salvage artifact: a hard TPU worker fault in the 100M rows
             # kills the process before the stdout JSON line prints; the
             # already-measured headline + suite + validation survive here.
-            import os
-
             here = os.path.dirname(os.path.abspath(__file__))
             partial = os.path.join(here, "BENCH_PARTIAL.json")
             with open(partial, "w") as fh:
@@ -449,7 +466,23 @@ def main() -> int:
             # The run completed: drop the salvage file so a stale partial
             # can't masquerade as a later run's salvage.
             os.unlink(partial)
-    print(json.dumps(result))
+    # The FULL record goes to bench_out.json; stdout ends with exactly ONE
+    # compact JSON line so the driver's tail capture always parses
+    # (VERDICT r4 #8: the old full-record line overflowed the captured
+    # tail and recorded "parsed": null).  The compact line carries the
+    # headline metric plus the north-star scalars.
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "bench_out.json"), "w") as fh:
+        json.dump(result, fh, indent=1)
+    line = {k: v for k, v in result.items() if k != "detail"}
+    d = result["detail"]
+    for row in ("jax_100m_99pct", "jax_100m_99pct_nosuppress", "jax_100m",
+                "two_phase_100m"):
+        if row in d and "error" not in d[row]:
+            line[row + "_s"] = round(
+                d[row].get("run_s", d[row].get("wall_s", 0.0)) or 0.0, 2)
+    line["detail_file"] = "bench_out.json"
+    print(json.dumps(line))
     return 0
 
 

@@ -112,6 +112,21 @@ class Config:
     # Event engine drain chunk size (-1 = auto: 524288; see
     # event.drain_chunk).
     event_chunk: int = -1
+    # Guaranteed-duplicate suppression at append (event engine): an edge
+    # whose destination already has the received bit -- monotone, so it is
+    # STILL set at delivery -- can only increment total_message there
+    # (simulator.go:111,117-119); with an effective crash rate of 0 there
+    # is not even a crash draw.  Suppression counts such edges into
+    # total_message at append time and never writes them into the mail
+    # ring (~4.8x of endgame traffic at fanout 6).  Received trajectory
+    # and final totals are bit-identical (A/B-tested); per-window
+    # total_message attribution shifts up to delayhigh ms earlier in the
+    # JSONL log.  "auto" = on iff the effective crash rate is 0 (which
+    # includes the reference's own default: crashrate 0.001 truncates to
+    # 0 under its 1%-resolution Bernoulli, simulator.go:180); "on" errors
+    # when crash_p > 0 -- per-reception crash draws are keyed by mailbox
+    # position, so removing entries would shift every later draw.
+    dup_suppress: str = "auto"
     # Phase-1 overlay timing (graph=overlay): "rounds" batches membership
     # into synchronous rounds, delivering every emission exactly one round
     # later and ESTIMATING stabilization time as rounds x mean_delay;
@@ -194,6 +209,24 @@ class Config:
         if self.graph == "erdos":
             return self.er_p_resolved * self.n
         return float(self.max_degree)
+
+    @property
+    def crashrate_eff(self) -> float:
+        """Effective per-reception crash probability (the compat gate's
+        1%-resolution truncation applied -- simulator.go:180; mirrors
+        epidemic.p_eff, which models/ keep for jit-time constants)."""
+        if self.compat_reference:
+            return int(self.crashrate * 100) / 100.0
+        return self.crashrate
+
+    @property
+    def dup_suppress_resolved(self) -> bool:
+        """Whether the event engine suppresses guaranteed-duplicate edges
+        at append (see the `dup_suppress` field comment).  Only sound at
+        crash_p == 0; validate() rejects an explicit "on" otherwise."""
+        if self.dup_suppress == "off":
+            return False
+        return self.crashrate_eff == 0.0
 
     @property
     def effective_time_mode(self) -> str:
@@ -344,6 +377,16 @@ class Config:
                 f"compact must be auto|on|off, got {self.compact!r}")
         if self.engine not in ENGINES:
             raise ValueError(f"engine must be one of {ENGINES}, got {self.engine!r}")
+        if self.dup_suppress not in ("auto", "on", "off"):
+            raise ValueError(
+                f"dup_suppress must be auto|on|off, got {self.dup_suppress!r}")
+        if self.dup_suppress == "on" and self.crashrate_eff > 0.0:
+            raise ValueError(
+                "-dup-suppress on requires an effective crash rate of 0 "
+                "(crash draws are keyed by mailbox position; suppressing "
+                "entries would shift every later draw).  Note the "
+                "reference's own default crashrate 0.001 IS 0 under "
+                "-compat-reference (1%-resolution truncation).")
         if self.engine == "event":
             if (self.protocol not in ("si", "sir")
                     or self.effective_time_mode != "ticks"):
@@ -470,6 +513,11 @@ def _build_parser() -> argparse.ArgumentParser:
                    dest="event_slot_cap", type=int, default=d.event_slot_cap)
     p.add_argument("-event-chunk", "--event-chunk", dest="event_chunk",
                    type=int, default=d.event_chunk)
+    p.add_argument("-dup-suppress", "--dup-suppress", dest="dup_suppress",
+                   choices=("auto", "on", "off"), default=d.dup_suppress,
+                   help="suppress guaranteed-duplicate sends at append "
+                        "(event engine, crash rate 0 only; auto = on "
+                        "whenever sound)")
     p.add_argument("-overlay-mode", "--overlay-mode", dest="overlay_mode",
                    choices=("auto", "rounds", "ticks"),
                    default=d.overlay_mode)

@@ -97,20 +97,26 @@ class EventState(NamedTuple):
     flags: jnp.ndarray  # uint8[n]: bit0 received, bit1 crashed, bit2 removed (SIR)
     friends: jnp.ndarray  # int32[n, k]
     friend_cnt: jnp.ndarray  # int32[n]
-    # Flat (dw * cap + drain_chunk,) packed ring: slot s occupies
+    # Flat (dw * cap + ring_tail,) packed ring: slot s occupies
     # [s*cap, (s+1)*cap).  Stored flat (not (dw, cap)) so the append scatter
     # updates it in place -- a reshape round-trip defeats XLA's donation
     # aliasing and copies the multi-GB ring once per chunk (measured
-    # 6s/window at n=5e7).  The tail padding serves two purposes: index
-    # dw*cap is an explicit trash cell for overflowed writes (on the axon
-    # TPU stack, mode="drop" OOB semantics for flattened scatter indices
-    # were observed being miscompiled -- see epidemic.deposit_local), and a
-    # full drain_chunk of slack keeps the last drain slice of a full slot
-    # from clamping (clamped dynamic_slice would misalign entry validity).
-    mail_ids: jnp.ndarray  # int32[dw * cap + drain_chunk]
+    # 6s/window at n=5e7).  The tail slack (ring_tail) holds the diverted
+    # trash writes at UNIQUE positions (letting the append scatter claim
+    # unique_indices -- explicit in-bounds cells also dodge the axon
+    # mode="drop" OOB miscompile seen in epidemic.deposit_local) and keeps
+    # the last drain dynamic_slice of a full slot from clamping (a clamped
+    # slice would misalign entry validity).
+    mail_ids: jnp.ndarray  # int32[dw * cap + ring_tail]
     # (1, dw): node-axis-leading so the sharded backend stacks shards'
     # counts to (S, dw) under a P('nodes', None) spec.
     mail_cnt: jnp.ndarray  # int32[1, dw]
+    # Deferred total_message credits from duplicate suppression, bucketed
+    # by arrival window slot (append_messages docstring); credited into
+    # the window's dm when it drains and zeroed with mail_cnt.  Bound: one
+    # window's suppressed edges <= n * k < 2^31 at every reachable config
+    # (n is already bounded tighter by flat mailbox addressing).
+    sup_cnt: jnp.ndarray  # int32[1, dw]
     tick: jnp.ndarray  # int32[]
     total_message: jnp.ndarray  # uint32[2] hi/lo 64-bit pair (state.msg64_*)
     total_received: jnp.ndarray  # int32[]
@@ -171,9 +177,38 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
         # Auto sizing also respects HBM: bound the whole ring to ~3 GB
         # (validated headroom for the 100M single-chip run on a 16 GB v5e;
         # overflow past the cap is counted in mail_dropped, never silent).
-        # An explicit -event-slot-cap overrides this.
-        cap = min(cap, (3 * 2**30) // (4 * max(dw, 1)))
+        # An explicit -event-slot-cap overrides this.  Under duplicate
+        # suppression (SI) the band halves: append-side filtering cut the
+        # measured peak slot occupancy 1.86x (94.8M vs 176.4M at 1e8
+        # fanout 6, 2026-07-31), and the scatter/gather cost of every
+        # append batch scales with the RING size on this platform --
+        # cap 1.34e8 (1.6 GB ring) ran the 100M/99% row 2.2s faster than
+        # cap 2.68e8 (3.2 GB) at a 1.41x occupancy margin.  SIR keeps the
+        # full band (re-broadcasts break the broadcast-once occupancy
+        # argument).
+        hbm = (3 * 2**30 if not (cfg.dup_suppress_resolved
+                                 and cfg.protocol == "si")
+               else 3 * 2**29)
+        cap = min(cap, hbm // (4 * max(dw, 1)))
     return min(cap, (2**31 - 1) // max(dw, 1))
+
+
+def ring_tail(cfg: Config, n_local: int | None = None) -> int:
+    """Slack lanes past the last window slot.  Serves three purposes: an
+    explicit trash region for diverted scatter lanes, drain-slice clamp
+    protection (>= drain_chunk so the last dynamic_slice of a full slot
+    never clamps), and -- sized to one full append batch's lane count --
+    UNIQUE trash positions, which lets the mail scatter claim
+    unique_indices=True and skip XLA's sort-based duplicate combining
+    (profiled at 8.6 ms per batch at scap=1M x k=6 on v5e, plus combine
+    overhead inside the scatter fusion itself).  graph_width bounds the
+    per-sender lane count from above (kout tables are fanout wide;
+    overlay tables max_degree); +1 is the SIR trigger column."""
+    ccap = drain_chunk(cfg, n_local)
+    scap = sender_compaction_cap(cfg, ccap)
+    width = scap if scap else ccap
+    lanes = width * (cfg.graph_width + (1 if cfg.protocol == "sir" else 0))
+    return max(ccap, lanes)
 
 
 def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
@@ -201,6 +236,13 @@ def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
     else:
         r = max(1.0, cfg.mean_degree / 4.0)
         hi = 1_048_576 if r >= 1.5 else 524_288
+        if cfg.dup_suppress_resolved and r >= 1.5:
+            # Suppression shrinks the drained entry volume ~1.4x and the
+            # ring itself (slot_cap band), moving the optimum up again:
+            # 1e8 fanout 6 @99% swept 2026-07-31 (cap 1.34e8): 1M:27.6,
+            # 2M:24.9, 4M:24.3, 8M:26.6 s -- per-batch op floors beat
+            # element growth until ~4M.
+            hi = 4_194_304
         want = min(hi, max(131_072, int(n // 128 * r ** 3)))
         # Round up to a power of two: the sort pads to one internally, so
         # a 918k chunk costs a 1M sort but drains only 918k entries
@@ -218,9 +260,10 @@ def init_state(cfg: Config, friends: jnp.ndarray,
         friends=friends,
         friend_cnt=friend_cnt,
         mail_ids=jnp.zeros(
-            (ring_windows(cfg) * slot_cap(cfg, n) + drain_chunk(cfg, n),),
+            (ring_windows(cfg) * slot_cap(cfg, n) + ring_tail(cfg, n),),
             I32),
         mail_cnt=jnp.zeros((1, ring_windows(cfg)), I32),
+        sup_cnt=jnp.zeros((1, ring_windows(cfg)), I32),
         tick=z(), total_message=msg64_zero(), total_received=z(),
         total_crashed=z(),
         mail_dropped=z(), exchange_overflow=z(),
@@ -239,9 +282,27 @@ def _sender_keys(base_key, op: int, ticks, rows):
 
 def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
                     svalid, sticks, friends, friend_cnt, base_key,
-                    strig=None):
+                    strig=None, flags=None):
     """Emit each sender's broadcast (k sends, ONE shared delay drawn at its
     delivery tick -- simulator.go:141-142) into the packed mail ring.
+
+    `flags` non-None enables guaranteed-duplicate suppression (sound only
+    at crash_p == 0 -- Config.dup_suppress_resolved gates): a kept edge
+    whose destination already has the received bit never enters the ring
+    -- its delivery could only have incremented total_message
+    (simulator.go:111,117-119; received is monotone, and at crash_p == 0
+    there is no per-reception draw to preserve).  Suppressed edges are
+    returned as per-ARRIVAL-WINDOW counts `sup_adds[dw]` that the caller
+    banks in EventState.sup_cnt and credits to total_message when that
+    window drains -- the exact step its deliveries would have counted --
+    so every poll-cadence observable (per-window totals, stdout, JSONL,
+    death tick) is bit-identical to the unsuppressed path, not just the
+    final totals (A/B-tested).  Delay and drop draws are (tick,
+    sender-row)-keyed, so filtering edges shifts no stream.  Remaining
+    divergence envelope: under slot overflow a suppressed edge counts as
+    delivered where the unsuppressed path might have counted it into
+    mail_dropped (zero-overflow regimes -- every measured config -- are
+    unaffected).
 
     A sender's messages share one arrival tick, hence one window slot.
     Reservations are EXACT-size: each sender takes as many contiguous
@@ -259,7 +320,7 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     right after the sender's kept edges."""
     n, k = friends.shape
     dw = ring_windows(cfg)
-    cap = (mail_ids.shape[0] - drain_chunk(cfg, n)) // dw
+    cap = (mail_ids.shape[0] - ring_tail(cfg, n)) // dw
     b = batch_ticks(cfg)
     rows = jnp.where(svalid, sender_ids, n)
     sidx = jnp.where(svalid, sender_ids, 0)
@@ -284,6 +345,12 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     wslot = (arrive // b) % dw
     off = arrive % b
     edge = svalid[:, None] & ~drop & (sf >= 0)
+    dcnt = None
+    if flags is not None:
+        dstf = flags.at[jnp.where(sf >= 0, sf, 0)].get()
+        dup = edge & ((dstf & RECEIVED) > 0)
+        dcnt = dup.sum(axis=1, dtype=I32)  # suppressed edges per sender
+        edge = edge & ~dup
     cols = jnp.cumsum(edge, axis=1, dtype=I32) - 1  # kept-edge rank in row
     ec = edge.sum(axis=1, dtype=I32)  # kept edges per sender
     payload = sf * b + off[:, None]
@@ -306,16 +373,27 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     # subset of svalid) and live rows are bit-identical.
     oh = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
           & svalid[:, None]).astype(I32)
+    # Suppressed-edge counts bucketed by arrival window via the SAME
+    # one-hot (fused reduction, no dw-gather/scatter); independent of the
+    # overflow check -- suppressed edges never consume ring capacity.
+    sup_adds = ((oh * dcnt[:, None]).sum(axis=0) if dcnt is not None
+                else jnp.zeros((dw,), I32))
     w = oh * ec[:, None]
     seg = ((jnp.cumsum(w, axis=0) - w) * oh).sum(axis=1)
     base = (mail_cnt[0][None, :] * oh).sum(axis=1)
     start = base + seg
     ok = svalid & (start + ec <= cap)
+    # Dead lanes divert to UNIQUE trash positions (ring_tail sizes the
+    # slack to one batch's lane count); live reservations are disjoint by
+    # construction, so the scatter can claim unique_indices and skip XLA's
+    # sort-based duplicate combining (profiled 8.6 ms/batch at 6.3M lanes).
+    nlanes = edge.shape[0] * edge.shape[1]
+    lane = jnp.arange(nlanes, dtype=I32).reshape(edge.shape)
     flat = jnp.where(edge & ok[:, None],
                      wslot[:, None] * cap + start[:, None] + cols,
-                     dw * cap)  # -> in-bounds trash cell
+                     dw * cap + lane)
     mail_ids = mail_ids.at[flat.reshape(-1)].set(
-        jnp.where(edge, payload, 0).reshape(-1))
+        jnp.where(edge, payload, 0).reshape(-1), unique_indices=True)
     # Overflowed senders are a per-slot suffix (start grows monotonically
     # within a slot), so counting only written reservations keeps
     # positions contiguous.
@@ -329,7 +407,64 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     # per sender precisely so this stays at zero; a nonzero mail_dropped
     # under SIR should be treated as an undersized -event-slot-cap, not as
     # ordinary message loss (see README divergence table).
-    return mail_ids, new_cnt, dropped + lost
+    return mail_ids, new_cnt, dropped + lost, sup_adds
+
+
+# Pre-drain compaction engages only once received-fraction crosses this
+# (measured 2026-07-31, 1e8 fanout 6 v5e: at 42%/78% received the filter's
+# RANDOM flags gather costs more than the sorted drain it shrinks -- +1.05s
+# and +0.56s per window -- while at 96% it wins -1.0s; the sort's ascending
+# locality is what the platform rewards, same lesson as the scatter-min
+# dead end).  Below the threshold the filter runs zero chunks.
+PREDRAIN_MIN_RECV_FRAC = 0.9
+
+
+def predrain_compact(b: int, n_rows: int, dw: int, cap: int, ccap: int,
+                     sir: bool, flags, mail_ids, slot, m):
+    """Filter the due window's slot against the CURRENT flags before the
+    chunked drain (crash_p == 0 gate -- same soundness as append-side
+    suppression, shared by the single-device and sharded steps): a data
+    entry whose destination's received bit is set can only increment
+    total_message in this very window, so it is counted here and compacted
+    away instead of paying the sorted drain.  Catches the duplicates the
+    append-side filter structurally cannot -- those appended BEFORE their
+    destination flipped received (the exponential-phase majority: measured
+    ~80% of endgame ring traffic at 1e8 fanout 6).  The slot's content is
+    frozen once its window starts (delay >= B), so filtering at drain
+    start sees final content.  Stable compaction (rank = running kept
+    count) preserves entry order, so retained entries keep the exact
+    first-encountered semantics; chunk boundaries shift with occupancy,
+    the same envelope as any event_chunk change.  SIR: triggers
+    (ent >= n*b) are never data and always kept.
+
+    In-place safety: chunk j's scatter writes land strictly below
+    position (j+1)*ccap (kept <= j*ccap), so no later chunk reads a
+    written lane.  `m` may be a traced scalar and the caller may pass 0
+    chunks' worth (m=0 disables); returns
+    (mail_ids, kept_total, filtered_data)."""
+    nf = (m + ccap - 1) // ccap
+
+    def fbody(j, carry):
+        mail, kept, fdat = carry
+        off0 = j * ccap
+        pos = off0 + jnp.arange(ccap, dtype=I32)
+        valid = pos < m
+        ent = jax.lax.dynamic_slice(mail, (slot * cap + off0,), (ccap,))
+        is_data = valid & (ent < n_rows * b) if sir else valid
+        idx = jnp.where(is_data, jnp.minimum(ent // b, n_rows - 1), 0)
+        f = flags.at[idx].get()
+        drop = is_data & ((f & RECEIVED) > 0)
+        keep = valid & ~drop
+        rank = kept + jnp.cumsum(keep.astype(I32)) - 1
+        lane = jnp.arange(ccap, dtype=I32)  # unique trash (ccap <= tail)
+        tgt = jnp.where(keep, slot * cap + rank, dw * cap + lane)
+        mail = mail.at[tgt].set(jnp.where(keep, ent, 0),
+                                unique_indices=True)
+        return mail, kept + keep.sum(dtype=I32), fdat + drop.sum(dtype=I32)
+
+    return jax.lax.fori_loop(
+        0, nf, fbody,
+        (mail_ids, jnp.zeros((), I32), jnp.zeros((), I32)))
 
 
 def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
@@ -397,8 +532,14 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     else:
         ids_s = key1_s // b
         touched = is_data
-    idx = jnp.where(touched, ids_s, 0)
-    pre = flags[idx]
+    # Touched lanes are a PREFIX (sentinels sort last) with ascending ids,
+    # so for SI the gather/scatter below can claim sorted indices (trash
+    # lanes ride at n_rows, clamped by the gather / dropped by the
+    # scatter).  SIR cannot: trigger ids restart below the data run's
+    # tail.
+    srt = not sir
+    idx = jnp.where(touched, ids_s, n_rows)
+    pre = flags.at[idx].get(indices_are_sorted=srt, mode="clip")
     pre_recv = (pre & RECEIVED) > 0
     if crash_p > 0.0:
         pre_crash = ((pre & CRASHED) > 0) & touched
@@ -416,6 +557,8 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
         run_crash = first & crash_s & ~pre_crash
         dc = run_crash.sum(dtype=I32)
         delta = delta + run_crash.astype(jnp.uint8) * CRASHED
+    # (No sorted claim here: non-winning lanes divert to n_rows BETWEEN
+    # the ascending winners, breaking monotonicity.)
     flags = flags.at[jnp.where(delta > 0, ids_s, n_rows)].add(
         delta, mode="drop")
     senders = newly
@@ -559,25 +702,47 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
     ccap = drain_chunk(cfg, n_local)
+    tail = ring_tail(cfg, n_local)
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
     sir = cfg.protocol == "sir"
     removal_p = epidemic.p_eff(cfg, cfg.removal_rate) if sir else 0.0
     scap = sender_compaction_cap(cfg, ccap)
+    # Guaranteed-duplicate suppression (append_messages docstring); the
+    # resolved gate implies crash_p == 0 (config.validate rejects "on"
+    # otherwise), so the per-reception draw stream it would shift is empty.
+    suppress = cfg.dup_suppress_resolved
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.flags.shape[0]
         w = st.tick // b
         slot = w % dw
         m = st.mail_cnt[0, slot]
+        dm0 = st.sup_cnt[0, slot]
+        mail0 = st.mail_ids
+        if suppress:
+            # Pre-drain compaction: duplicates that slipped past the
+            # append-side filter die here, before the sorted drain pays
+            # for them -- but only in the endgame regime where the
+            # filter's random gather beats the drain it removes
+            # (PREDRAIN_MIN_RECV_FRAC).  Zero filter chunks otherwise.
+            cap0 = (mail0.shape[0] - tail) // dw
+            go = st.total_received >= I32(
+                int(PREDRAIN_MIN_RECV_FRAC * n))
+            mail0, kept, fdat = predrain_compact(
+                b, n, dw, cap0, ccap, sir, st.flags, mail0, slot,
+                jnp.where(go, m, 0))
+            m = jnp.where(go, kept, m)
+            dm0 = dm0 + fdat
         chunks = (m + ccap - 1) // ccap
         ckey = _rng.tick_key(base_key, w, _rng.OP_CRASH)
 
         def body(j, carry):
-            (flags, mail_ids, mail_cnt, dm, dr, dc, dropped) = carry
+            (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
+             dropped) = carry
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
-            cap = (mail_ids.shape[0] - ccap) // dw
+            cap = (mail_ids.shape[0] - tail) // dw
             packed = jax.lax.dynamic_slice(
                 mail_ids, (slot * cap + off0,), (ccap,))
             flags, cdm, cdr, cdc, ids_s, toff_s, senders = \
@@ -595,7 +760,8 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
 
                 def make_abody(width, lo_of):
                     def abody(jb, acarry):
-                        aflags, amail_ids, amail_cnt, adropped = acarry
+                        (aflags, amail_ids, amail_cnt, asup,
+                         adropped) = acarry
                         sids, stoff, svalid = sender_batch(
                             senders, srank, scnt, spacked, b, width, jb,
                             lo=lo_of(jb))
@@ -618,20 +784,25 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                                 jnp.where(rem, sids, n)].add(
                                 REMOVED, mode="drop")
                             strig = svalid & ~rem
-                        amail_ids, amail_cnt, adropped = append_messages(
+                        amail_ids, amail_cnt, adropped, sa = append_messages(
                             cfg, amail_ids, amail_cnt, adropped, sids,
                             svalid, stick2, st.friends, st.friend_cnt,
-                            base_key, strig=strig)
-                        return (aflags, amail_ids, amail_cnt, adropped)
+                            base_key, strig=strig,
+                            flags=aflags if suppress else None)
+                        return (aflags, amail_ids, amail_cnt,
+                                asup + sa[None, :], adropped)
                     return abody
 
                 # Small remainders run as 1-2 narrow batches at ~op-floor
                 # cost instead of one element-bound full-width batch
                 # (narrow_tail_cap's rationale; run_narrow_tail drives).
-                flags, mail_ids, mail_cnt, dropped = run_narrow_tail(
-                    make_abody, (flags, mail_ids, mail_cnt, dropped),
+                (flags, mail_ids, mail_cnt, sup_cnt,
+                 dropped) = run_narrow_tail(
+                    make_abody,
+                    (flags, mail_ids, mail_cnt, sup_cnt, dropped),
                     scnt, scap)
-                return (flags, mail_ids, mail_cnt, dm, dr, dc, dropped)
+                return (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
+                        dropped)
             sticks = w * b + toff_s
             strig = None
             if sir:
@@ -655,22 +826,30 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
             # ~6-10% SLOWER at n=1e7/1e8 fanout 3 -- the 5-op selection
             # cost more than the 2.4x width saving; the 2-op rank-scatter
             # compaction above pays only at higher degree.)
-            mail_ids, mail_cnt, dropped = append_messages(
+            mail_ids, mail_cnt, dropped, sa = append_messages(
                 cfg, mail_ids, mail_cnt, dropped,
                 jnp.where(senders, ids_s, 0), senders, sticks,
-                st.friends, st.friend_cnt, base_key, strig=strig)
-            return (flags, mail_ids, mail_cnt, dm, dr, dc, dropped)
+                st.friends, st.friend_cnt, base_key, strig=strig,
+                flags=flags if suppress else None)
+            return (flags, mail_ids, mail_cnt, sup_cnt + sa[None, :],
+                    dm, dr, dc, dropped)
 
         z = jnp.zeros((), I32)
-        (flags, mail_ids, mail_cnt, dm, dr, dc,
+        # Credit this window's deferred duplicate counts (banked by
+        # append_messages at append time) exactly where their deliveries
+        # would have counted; appends during this drain only target later
+        # windows (delay >= B), so the slot accrues nothing new before the
+        # zeroing below.
+        (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
          dropped) = jax.lax.fori_loop(
             0, chunks, body,
-            (st.flags, st.mail_ids, st.mail_cnt, z, z, z,
-             st.mail_dropped))
+            (st.flags, mail0, st.mail_cnt, st.sup_cnt,
+             dm0, z, z, st.mail_dropped))
         mail_cnt = mail_cnt.at[0, slot].set(0)
+        sup_cnt = sup_cnt.at[0, slot].set(0)
         return st._replace(
             flags=flags, mail_ids=mail_ids,
-            mail_cnt=mail_cnt, tick=st.tick + b,
+            mail_cnt=mail_cnt, sup_cnt=sup_cnt, tick=st.tick + b,
             total_message=msg64_add(st.total_message, dm),
             total_received=st.total_received + dr,
             total_crashed=st.total_crashed + dc,
@@ -689,7 +868,7 @@ def make_seed_fn(cfg: Config):
         n = st.flags.shape[0]
         b = batch_ticks(cfg)
         dw = ring_windows(cfg)
-        cap = (st.mail_ids.shape[0] - drain_chunk(cfg, n)) // dw
+        cap = (st.mail_ids.shape[0] - ring_tail(cfg, n)) // dw
         ks = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_SEED_NODE)
         kd = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DELAY)
         kp = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_DROP)
@@ -731,7 +910,8 @@ def make_seed_fn(cfg: Config):
             ec = ec + keep.astype(I32)
         base = st.mail_cnt[0, wslot]
         ok = base + ec <= cap
-        flat = jnp.where(edge & ok, wslot * cap + base + cols, dw * cap)
+        flat = jnp.where(edge & ok, wslot * cap + base + cols,
+                         dw * cap + jnp.arange(edge.shape[0], dtype=I32))
         mail_ids = st.mail_ids.at[flat].set(
             jnp.where(edge, payload, 0))  # trash cell if !ok / non-edge
         mail_cnt = st.mail_cnt.at[0, wslot].add(jnp.where(ok, ec, 0))

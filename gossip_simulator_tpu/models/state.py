@@ -83,7 +83,13 @@ def in_flight(st) -> jnp.ndarray:
     (backends/base.run_bounded_to_target) and every engine's device-side
     run cond all call this, so they cannot drift."""
     if hasattr(st, "mail_cnt"):
-        return jnp.any(st.mail_cnt > 0).astype(jnp.int32)
+        # sup_cnt: deferred duplicate-suppression credits (EventState) --
+        # pending windows must still drain so total_message is credited at
+        # the same tick the unsuppressed path would have counted it.
+        live = jnp.any(st.mail_cnt > 0)
+        if hasattr(st, "sup_cnt"):
+            live = live | jnp.any(st.sup_cnt > 0)
+        return live.astype(jnp.int32)
     return (jnp.any(st.pending > 0) | jnp.any(st.rebroadcast)).astype(
         jnp.int32)
 

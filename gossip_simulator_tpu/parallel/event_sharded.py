@@ -57,7 +57,7 @@ def event_state_specs() -> EventState:
     return EventState(
         flags=P(AXIS),
         friends=P(AXIS, None), friend_cnt=P(AXIS),
-        mail_ids=P(AXIS), mail_cnt=P(AXIS, None),
+        mail_ids=P(AXIS), mail_cnt=P(AXIS, None), sup_cnt=P(AXIS, None),
         tick=P(), total_message=P(), total_received=P(), total_crashed=P(),
         mail_dropped=P(), exchange_overflow=P(),
     )
@@ -95,19 +95,31 @@ def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
     from gossip_simulator_tpu.ops.mailbox import ring_append
 
     dw = event.ring_windows(cfg)
-    cap = (mail.shape[0] - event.drain_chunk(cfg, n_local)) // dw
+    cap = (mail.shape[0] - event.ring_tail(cfg, n_local)) // dw
     (mail,), cnt, dropped = ring_append(
         (mail,), cnt, dropped, (payload,), wslot, valid, dw, cap)
     return mail, cnt, dropped
 
 
 def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
-                      dropped, xovf, dst_global, wslot, off, valid, rcap):
+                      dropped, xovf, dst_global, wslot, off, valid, rcap,
+                      flags=None):
     """Route (global dst, window slot, tick offset) messages to their owner
     shards and append into the local mail ring.
 
     `wslot`/`off` are per-message arrays the same shape as `dst_global`.
-    Returns (mail, cnt, dropped, xovf)."""
+    `flags` non-None enables guaranteed-duplicate suppression on the
+    RECEIVING side (the sharded analog of event.append_messages' append-
+    side filter; sender-side is impossible -- remote destinations' flags
+    live on their owner shard): routed messages whose local destination
+    already has the received bit never enter the ring; they are returned
+    as per-arrival-window counts `sup_adds[dw]` the caller banks in
+    sup_cnt and credits to the psum'd total_message when that window
+    drains -- the same deferred-credit scheme as the single-device
+    append_messages, so per-window observables stay bit-identical.
+    Retained entries keep their relative emission order, so at
+    crash_p == 0 (the Config.dup_suppress_resolved gate) the trajectory
+    is bit-identical.  Returns (mail, cnt, dropped, xovf, sup_adds)."""
     b = event.batch_ticks(cfg)
     dw = event.ring_windows(cfg)
     dest = jnp.where(valid, dst_global // n_local, n_shards)
@@ -120,9 +132,17 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     rdstl = r // (dw * b)
     rw = (r // b) % dw
     roff = r % b
+    sup_adds = jnp.zeros((dw,), I32)
+    if flags is not None:
+        dup = rvalid & ((flags.at[rdstl].get() & event.RECEIVED) > 0)
+        # One-hot reduction over the tiny dw axis (fuses; a dw-bin
+        # scatter-add would serialize -- see append_messages' oh note).
+        sup_adds = ((rw[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+                    & dup[:, None]).sum(axis=0, dtype=I32)
+        rvalid = rvalid & ~dup
     mail, cnt, dropped = _ring_append(
         cfg, n_local, mail, cnt, dropped, rdstl * b + roff, rw, rvalid)
-    return mail, cnt, dropped, xovf + ovf
+    return mail, cnt, dropped, xovf + ovf, sup_adds
 
 
 def _append_local_triggers(cfg: Config, n_local: int, mail, cnt, dropped,
@@ -145,6 +165,7 @@ def make_sharded_event_step(cfg: Config, mesh):
     b = event.batch_ticks(cfg)
     dw = event.ring_windows(cfg)
     ccap = event.drain_chunk(cfg, n_local)
+    tail = event.ring_tail(cfg, n_local)
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
     drop_p = epidemic.p_eff(cfg, cfg.droprate)
     sir = cfg.protocol == "sir"
@@ -159,6 +180,9 @@ def make_sharded_event_step(cfg: Config, mesh):
             f"* B ({b}) must stay below 2^31; use more shards")
     # Same degree-gated sender-compaction width as the single-device step.
     scap = event.sender_compaction_cap(cfg, ccap)
+    # Receiving-side duplicate suppression (_route_and_append docstring);
+    # the resolved gate implies crash_p == 0.
+    suppress = cfg.dup_suppress_resolved
 
     def step_shard(st: EventState, base_key: jax.Array) -> EventState:
         shard = jax.lax.axis_index(AXIS)
@@ -166,6 +190,22 @@ def make_sharded_event_step(cfg: Config, mesh):
         w = st.tick // b
         slot = w % dw
         m = st.mail_cnt[0, slot]
+        dm0 = st.sup_cnt[0, slot]
+        mail0 = st.mail_ids
+        cap0 = (mail0.shape[0] - tail) // dw
+        if suppress:
+            # Pre-drain compaction on the local slot (local flags; see
+            # event.predrain_compact) in the endgame regime only
+            # (event.PREDRAIN_MIN_RECV_FRAC; total_received is replicated,
+            # so every shard agrees).  Chunk count is pmax-agreed on the
+            # POST-filter occupancy below.
+            go = st.total_received >= I32(
+                int(event.PREDRAIN_MIN_RECV_FRAC * cfg.n))
+            mail0, kept, fdat = event.predrain_compact(
+                b, n_local, dw, cap0, ccap, sir, st.flags, mail0, slot,
+                jnp.where(go, m, 0))
+            m = jnp.where(go, kept, m)
+            dm0 = dm0 + fdat
         chunks = (jax.lax.pmax(m, AXIS) + ccap - 1) // ccap
         ckey = _rng.tick_key(skey, w, _rng.OP_CRASH)
         kwidth = st.friends.shape[1]
@@ -176,7 +216,7 @@ def make_sharded_event_step(cfg: Config, mesh):
         # ccap * kwidth -- an epidemic_cap-style mean*safety bound would
         # drop skewed batches at n_shards > 4.  Computed per batch width
         # in make_abody (full scap and narrow scap/8 widths).
-        cap = (st.mail_ids.shape[0] - ccap) // dw
+        cap = cap0
 
         def emit(flags, mail, cnt, dropped, xovf, sids, svalid, sticks,
                  width, ecap):
@@ -219,21 +259,21 @@ def make_sharded_event_step(cfg: Config, mesh):
                     event.REMOVED, mode="drop")
             edge = svalid[:, None] & ~drop & (sf >= 0)
             dstg = jnp.where(edge, sf, 0).reshape(-1)
-            mail, cnt, dropped, xovf = _route_and_append(
+            mail, cnt, dropped, xovf, nsup = _route_and_append(
                 cfg, s, n_local, mail, cnt, dropped, xovf, dstg,
                 jnp.broadcast_to(wslot2[:, None],
                                  (width, kwidth)).reshape(-1),
                 jnp.broadcast_to(off2[:, None],
                                  (width, kwidth)).reshape(-1),
-                edge.reshape(-1), ecap)
+                edge.reshape(-1), ecap, flags=flags if suppress else None)
             if sir:
                 mail, cnt, dropped = _append_local_triggers(
                     cfg, n_local, mail, cnt, dropped, rows, svalid & ~rem,
                     wslot2, off2)
-            return flags, mail, cnt, dropped, xovf
+            return flags, mail, cnt, dropped, xovf, nsup
 
         def body(j, carry):
-            (flags, mail, cnt, dm, dr, dc, dropped, xovf) = carry
+            (flags, mail, cnt, sup, dm, dr, dc, dropped, xovf) = carry
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
@@ -263,38 +303,47 @@ def make_sharded_event_step(cfg: Config, mesh):
                     # width * kwidth: zero-loss per-pair receive buffer
                     # at this batch width (see the step-level comment).
                     def abody(jb, acarry):
-                        aflags, amail, acnt, adropped, axovf = acarry
+                        aflags, amail, acnt, asup, adropped, axovf = acarry
                         bids, btoff, bvalid = event.sender_batch(
                             senders, srank, scnt, spacked, b, width, jb,
                             lo=lo_of(jb))
-                        return emit(aflags, amail, acnt, adropped, axovf,
+                        (aflags, amail, acnt, adropped, axovf,
+                         sa) = emit(aflags, amail, acnt, adropped, axovf,
                                     bids, bvalid, w * b + btoff, width,
                                     width * kwidth)
+                        return (aflags, amail, acnt, asup + sa[None, :],
+                                adropped, axovf)
                     return abody
 
                 # Shared schedule + driver (event.run_narrow_tail) on the
                 # pmax-agreed smax, so every shard still runs the same
                 # number of all_to_alls.
-                flags, mail, cnt, dropped, xovf = event.run_narrow_tail(
-                    make_abody, (flags, mail, cnt, dropped, xovf), smax,
-                    scap)
+                (flags, mail, cnt, sup, dropped,
+                 xovf) = event.run_narrow_tail(
+                    make_abody,
+                    (flags, mail, cnt, sup, dropped, xovf), smax, scap)
             else:
-                flags, mail, cnt, dropped, xovf = emit(
+                flags, mail, cnt, dropped, xovf, sa = emit(
                     flags, mail, cnt, dropped, xovf, ids_s, senders,
                     w * b + toff_s, ccap, rcap)
-            return (flags, mail, cnt, dm, dr, dc, dropped, xovf)
+                sup = sup + sa[None, :]
+            return (flags, mail, cnt, sup, dm, dr, dc, dropped, xovf)
 
         z = jnp.zeros((), I32)
-        (flags, mail, cnt, dm, dr, dc, ddrop,
+        # dm starts at this shard's deferred duplicate credits for the
+        # draining window (banked by _route_and_append; appends during
+        # this drain only target later windows), zeroed with mail_cnt.
+        (flags, mail, cnt, sup, dm, dr, dc, ddrop,
          dxovf) = jax.lax.fori_loop(
             0, chunks, body,
-            (st.flags, st.mail_ids, st.mail_cnt, z, z, z, z,
-             z))
+            (st.flags, mail0, st.mail_cnt, st.sup_cnt,
+             dm0, z, z, z, z))
         cnt = cnt.at[0, slot].set(0)
+        sup = sup.at[0, slot].set(0)
         dm, dr, dc, ddrop, dxovf = jax.lax.psum((dm, dr, dc, ddrop, dxovf),
                                                 AXIS)
         return st._replace(
-            flags=flags, mail_ids=mail, mail_cnt=cnt,
+            flags=flags, mail_ids=mail, mail_cnt=cnt, sup_cnt=sup,
             tick=st.tick + b,
             total_message=msg64_add(st.total_message, dm),
             total_received=st.total_received + dr,
@@ -345,7 +394,9 @@ def make_sharded_event_seed(cfg: Config, mesh):
         # The seed emits at most kwidth messages total; a wave-sized route
         # buffer here would allocate epidemic_cap (~GBs at 1e8) for nothing.
         rcap = min(exchange.epidemic_cap(n_local, kwidth, s), kwidth)
-        mail, cnt, dropped, xovf = _route_and_append(
+        # No suppression at seed time (flags=None): the only set received
+        # bit is the seed's own and no generator produces self-edges.
+        mail, cnt, dropped, xovf, _ = _route_and_append(
             cfg, s, n_local, st.mail_ids, st.mail_cnt, jnp.zeros((), I32),
             jnp.zeros((), I32), jnp.where(edge, sf, 0),
             jnp.broadcast_to((arrive // b) % dw, (kwidth,)),

@@ -85,7 +85,8 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
         dw = event.ring_windows(cfg)
         ncap = event.slot_cap(cfg, n_local)
         nchunk = event.drain_chunk(cfg, n_local)
-        per_new = dw * ncap + nchunk
+        ntail = event.ring_tail(cfg, n_local)
+        per_new = dw * ncap + ntail
         geom = tree.pop("mail_geom", None)
         s_ckpt = (int(geom[2]) if geom is not None and len(geom) > 2 else 1)
         if s_ckpt != n_shards:
@@ -101,6 +102,12 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                 f"{tuple(tree['mail_cnt'].shape)} does not match this "
                 f"config's ({n_shards}, {dw}); restore with the snapshot's "
                 "-delaylow/-delayhigh")
+        if "sup_cnt" not in tree:
+            # Pre-dup-suppression snapshot (rounds <= 4): no deferred
+            # duplicate credits pending.  (Crediting is unconditional in
+            # the window step, so restoring a suppress-on snapshot into a
+            # suppress-off run -- or vice versa -- stays consistent.)
+            tree["sup_cnt"] = np.zeros((n_shards, dw), np.int32)
         mail_len = int(tree["mail_ids"].shape[0])
         if geom is None:
             # Legacy snapshot without geometry metadata: accept only an
@@ -114,20 +121,25 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                     "it was written with")
         else:
             ocap, ochunk = int(geom[0]), int(geom[1])
-            per_old = dw * ocap + ochunk
-            if mail_len != n_shards * per_old:
+            # The tail is derived, not stored: recover it from the actual
+            # length (pre-round-5 snapshots have tail == chunk; newer ones
+            # ring_tail).  Anything below the chunk contradicts every
+            # layout that ever existed.
+            per_old = mail_len // n_shards
+            otail = per_old - dw * ocap
+            if mail_len % n_shards or otail < ochunk:
                 raise ValueError(
                     f"checkpoint mail_ids length {mail_len} contradicts "
                     f"its stored geometry (cap={ocap}, chunk={ochunk}, "
                     f"{n_shards} shard(s))")
-            if (ocap, ochunk) != (ncap, nchunk):
+            if per_old != per_new or ocap != ncap:
                 old = np.asarray(tree["mail_ids"])
                 cnt = np.asarray(tree["mail_cnt"])
                 mails, cnts, lost = [], [], 0
                 for sh in range(n_shards):
                     m, c, sl = repack_mail_ring(
                         old[sh * per_old:(sh + 1) * per_old], cnt[sh],
-                        ocap, ochunk, ncap, nchunk, dw)
+                        ocap, otail, ncap, ntail, dw)
                     mails.append(m)
                     cnts.append(c)
                     lost += sl
@@ -208,21 +220,21 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
 
 
 def repack_mail_ring(mail: np.ndarray, cnt: np.ndarray, ocap: int,
-                     ochunk: int, ncap: int, nchunk: int,
+                     otail: int, ncap: int, ntail: int,
                      dw: int) -> tuple[np.ndarray, np.ndarray, int]:
     """Repack one packed mail ring (models/event.py layout: slot s occupies
-    [s*cap, (s+1)*cap), plus a drain-chunk tail) from slot geometry
-    (ocap, ochunk) to (ncap, nchunk) -- snapshots written under different
+    [s*cap, (s+1)*cap), plus a `tail` slack region) from slot geometry
+    (ocap, otail) to (ncap, ntail) -- snapshots written under different
     -event-* flags or an auto sizing that changed.  Entries beyond the new
     capacity are dropped (returned in `lost`, counted like any overflow).
 
     `cnt` is the per-slot entry count, shape (dw,).  Returns
     (new_mail, clamped_cnt, lost)."""
-    if mail.shape[0] != dw * ocap + ochunk:
+    if mail.shape[0] != dw * ocap + otail:
         raise ValueError(
             f"mail ring length {mail.shape[0]} contradicts its geometry "
-            f"(cap={ocap}, chunk={ochunk}, dw={dw})")
-    new = np.zeros((dw * ncap + nchunk,), mail.dtype)
+            f"(cap={ocap}, tail={otail}, dw={dw})")
+    new = np.zeros((dw * ncap + ntail,), mail.dtype)
     lost = 0
     for s in range(dw):
         take = min(int(cnt[s]), ncap)
