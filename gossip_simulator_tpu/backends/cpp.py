@@ -190,6 +190,12 @@ class CppMtStepper(Stepper):
         self._h = self._lib.mt_create(
             cfg.n, cfg.fanout, cfg.delaylow, cfg.delayhigh,
             cfg.droprate, cfg.crashrate, cfg.seed, self.nthreads)
+        if not self._h:
+            # mt_create range-checks n against its (tick << 32 | node)
+            # bucket packing (advisor r4) and returns NULL past 2^31.
+            raise ValueError(
+                f"cpp_mt: n={cfg.n} outside the packed-wire range "
+                "(n must be < 2^31)")
         self.exhausted = False
 
     def __del__(self):

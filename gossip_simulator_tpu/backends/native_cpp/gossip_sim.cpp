@@ -573,6 +573,12 @@ void sim_degrees(void* h, int32_t* out) {
 void* mt_create(int64_t n, int32_t fanout, int32_t delaylow, int32_t delayhigh,
                 double droprate, double crashrate, int32_t seed,
                 int32_t nthreads) {
+  // The bucket wire packs (arrival_tick << 32 | uint32(node)): both the
+  // node id and the arrival tick must fit 32/31 bits or the packing
+  // silently corrupts (see stage_broadcast).  SI arrival ticks are
+  // bounded by the run length (~hundreds of ms), far inside 2^31; the
+  // node bound is enforced here at the API boundary.
+  if (n <= 0 || n >= (int64_t(1) << 31)) return nullptr;
   MtSim* s = new MtSim();
   s->p = {n, fanout, fanout + 1, delaylow, delayhigh, droprate, crashrate,
           0.0,  0.0, SI, KOUT, 0, 0, seed};

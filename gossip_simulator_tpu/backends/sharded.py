@@ -98,6 +98,23 @@ class ShardedStepper(Stepper):
             self.ostate = (ots.make_sharded_init(cfg, self.mesh)(self.key)
                            if build_state else None)
         else:
+            n_local = shard_size(cfg.n, self.mesh)
+            if n_local >= overlay.SPLIT_ROUND_MIN_ROWS:
+                # The sharded rounds engine always runs the FUSED round
+                # inside shard_map (the split round's host-driven call
+                # sequence cannot run per shard); per-shard slices at
+                # memory scale can hit the fused-round OOM class the
+                # single-device split exists to avoid (advisor r4).
+                import warnings
+
+                warnings.warn(
+                    f"sharded overlay: {n_local} rows/shard is at the "
+                    f"fused-round memory band (>= "
+                    f"{overlay.SPLIT_ROUND_MIN_ROWS}); the sharded engine "
+                    "has no split-round fallback -- use at least "
+                    f"{cfg.n // overlay.SPLIT_ROUND_MIN_ROWS + 1} devices "
+                    "for this n, or expect HBM exhaustion on 16 GB chips",
+                    stacklevel=2)
             self._oround = sharded_step.make_overlay_round_fn(
                 cfg, self.mesh)
             self.ostate = (sharded_step.make_sharded_overlay_init(

@@ -55,6 +55,26 @@ I32 = jnp.int32
 # otherwise exercisable only by on-TPU runs).
 COLUMN_DELIVERY_MIN_ROWS = 4_000_000
 
+# Mailbox-overflow spill capacity (pairs per message type): overflow is the
+# in-degree tail past the mailbox cap -- 257 TOTAL messages over 31 rounds
+# at the 100M build's cap 8 (r4), so 64k pairs (512 KB) is ~250x the
+# largest observed round.  Spilled messages re-deliver first next round
+# (the reference's channel-full backpressure: delayed, never lost,
+# simulator.go:51-54); past the spill cap they fall back to counted drops.
+SPILL_CAP = 65_536
+
+
+def spill_enabled(cap: int) -> bool:
+    """Spill engages only below the full cap 16 -- i.e. the memory-banded
+    cap 8 (the ONLY regime that ever dropped: 257 messages at 1e8, r4)
+    and explicit tiny test caps.  At cap 16 overflow needs in-degree > 16
+    in one round (~1e-12 per node-round at the protocol's Poisson loads;
+    never observed), and threading the spill accumulator through every
+    delivery chunk costs real op floors (measured +10.6 s on the 27-round
+    10M build, 2026-08-01) -- so cap-16 configs keep the counted-drop
+    path."""
+    return cap < 16
+
 
 def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     n = n_local if n_local is not None else cfg.n
@@ -68,12 +88,19 @@ def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     # OverlayState field comment -- node-major and off-multiple slot
     # counts both padded catastrophically at n=1e8); bootstrap emissions
     # are their own flat vector.
+    # Non-spilling configs (spill_enabled) carry token-sized spill fields:
+    # the buffers are loop-invariant pass-throughs there, but full-size
+    # ones still measurably regressed the bounded phase-1 while_loop
+    # (+4.7 s on the 27-round 10M build).
+    sc = SPILL_CAP if spill_enabled(cap) else 0
     return OverlayState(
         friends=jnp.full((n, k), -1, I32),
         friend_cnt=jnp.zeros((n,), I32),
         mk_dst=jnp.full((cap, n), -1, I32),
         bk_dst=jnp.full((cap, n), -1, I32),
         boot_dst=jnp.full((n,), -1, I32),
+        mk_spill=jnp.full((2, sc + 1), -1, I32),
+        bk_spill=jnp.full((2, sc + 1), -1, I32),
         round=z(), makeups=z(), breakups=z(),
         win_makeups=z(), win_breakups=z(), mailbox_dropped=z(),
     )
@@ -223,10 +250,12 @@ def make_round_fn(cfg: Config,
             # ceil-per-slot chunks measured 4x SLOWER at 1M) and the
             # flattened node-major path stays -- the canonical arrival
             # order is size-banded, deterministic per config, and pinned
-            # by the goldens at small n.
+            # by the goldens at small n.  This path SPILLS overflow into
+            # (src, dst) pairs re-delivered first next round instead of
+            # dropping (SPILL_CAP; lossless membership delivery).
             flat_mbox = True
 
-            def deliver_matrix_fn(mats, cap, dep=None):
+            def deliver_matrix_fn(mats, cap, dep=None, spill_in=None):
                 carry = None
                 if dep is not None:
                     # Sequence this delivery's buffer allocations after
@@ -235,34 +264,44 @@ def make_round_fn(cfg: Config,
                     carry = (_dep_full((n * cap + 1,), -1, dep),
                              _dep_full((n + 1,), 0, dep),
                              jnp.zeros((), I32))
-                return deliver_columns(mats, n, cap, dchunk, flat=True,
-                                       carry=carry)
+                if not spill_enabled(cap):
+                    out = deliver_columns(mats, n, cap, dchunk, flat=True,
+                                          carry=carry)
+                    return out + (None,)
+                acc = (jnp.full((2, SPILL_CAP + 1), -1, I32),
+                       jnp.zeros((), I32))
+                mbox, load, dropped, (pairs, _) = deliver_columns(
+                    mats, n, cap, dchunk, flat=True, carry=carry,
+                    spill_in=spill_in, spill=acc)
+                return mbox, load, dropped, pairs
         else:
             # Small-n path, and past the flat-addressing boundary the
             # flattened path's dense 2-D fallback + one-time warning.
             # Slot-major flatten, matching the per-slot path's arrival
             # order exactly (sender = flat_idx % n) -- the canonical
-            # order no longer changes across the size band.
-            def deliver_matrix_fn(mats, cap, dep=None):
+            # order no longer changes across the size band.  No spill:
+            # at cap 16 (every n in this band) overflow needs in-degree
+            # > 16 in one round -- never observed; drops stay counted.
+            def deliver_matrix_fn(mats, cap, dep=None, spill_in=None):
                 flat = jnp.concatenate(mats, axis=0).reshape(-1)
                 mbox, cnt, dropped = deliver(None, flat, flat >= 0, n, cap,
                                              compact_chunk=dchunk,
                                              src_mod=n)
-                return mbox, cnt.max(initial=0), dropped
+                return mbox, cnt.max(initial=0), dropped, None
     else:
         # Hook supplied (the sharded backend's routed delivery): keep its
         # flattened (src, dst, valid) contract; the ids broadcast is only
         # materialized on this path.  Slot-major flatten (the emission
         # buffers' native layout; transposing at shard scale would
         # materialize the padded node-major shape).
-        def deliver_matrix_fn(mats, cap, dep=None):
+        def deliver_matrix_fn(mats, cap, dep=None, spill_in=None):
             matc = jnp.concatenate(mats, axis=0)
             flat = matc.reshape(-1)
             ids_b = jnp.broadcast_to(ids_fn()[None, :],
                                      matc.shape).reshape(-1)
             mbox, dropped = deliver_fn(ids_b, flat, flat >= 0, cap)
             return mbox, (mbox >= 0).sum(axis=1, dtype=I32).max(initial=0), \
-                dropped
+                dropped, None
     if ids_fn is None:
         ids_fn = lambda: jnp.arange(n, dtype=I32)
     if sum_fn is None:
@@ -292,9 +331,10 @@ def make_round_fn(cfg: Config,
     # (make_split_round_fn: one jitted call PER PIECE) run the exact same
     # closures -- only the jit boundary moves.
 
-    def p_bk_deliver(bk_dst):
-        """Deliver last round's BREAKUP emissions."""
-        return deliver_matrix_fn((bk_dst,), cap)
+    def p_bk_deliver(bk_dst, bk_spill):
+        """Deliver last round's BREAKUP emissions (the overflow spill
+        pairs first -- delayed messages arrive before this round's)."""
+        return deliver_matrix_fn((bk_dst,), cap, spill_in=bk_spill)
 
     def p_bk_process(friends, cnt, bk_mbox, n_bk, drop2, round_, base_key):
         """Process the breakup mailbox (simulator.go:76-94), emitting
@@ -325,23 +365,26 @@ def make_round_fn(cfg: Config,
         return jax.lax.fori_loop(
             0, n_bk, bk_body, (friends, cnt, mk_em, win_bk))
 
-    def p_mk_deliver(mk_dst, boot_dst, friends, cnt, win_bk):
+    def p_mk_deliver(mk_dst, boot_dst, mk_spill, friends, cnt, win_bk):
         """Deliver the MAKEUP emissions (the breakup mailbox is dead by
         now -- holding both ~3 GB mailboxes alive broke the 16 GB chip at
         n=1e8; sequencing is bit-identical since the deliveries are
-        data-independent).  Bootstrap makeups ride as one extra slot row
-        AFTER the replies -- the same order the old (cap+2)-wide buffer
-        delivered.  The optimization_barrier keeps XLA from hoisting this
-        above the breakup processing in the fused form."""
+        data-independent).  Spilled makeups first, then replies, then
+        bootstrap makeups as one extra slot row AFTER the replies -- the
+        same order the old (cap+2)-wide buffer delivered.  The
+        optimization_barrier keeps XLA from hoisting this above the
+        breakup processing in the fused form."""
         mk_src, boot_src, friends, cnt = jax.lax.optimization_barrier(
             (mk_dst, boot_dst, friends, cnt))
-        mk_mbox, n_mk, drop1 = deliver_matrix_fn(
-            (mk_src, boot_src[None, :]), cap, dep=win_bk)
-        return mk_mbox, n_mk, drop1, friends, cnt
+        mk_mbox, n_mk, drop1, mk_sp = deliver_matrix_fn(
+            (mk_src, boot_src[None, :]), cap, dep=win_bk,
+            spill_in=mk_spill)
+        return mk_mbox, n_mk, drop1, friends, cnt, mk_sp
 
     def p_mk_process(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em,
                      win_bk, round_, makeups0, breakups0, dropped0,
-                     base_key) -> OverlayState:
+                     base_key, mk_sp=None, bk_sp=None,
+                     spill0=None) -> OverlayState:
         """Process the makeup mailbox (simulator.go:66-75), bootstrap
         (simulator.go:95-106) and assemble the next state."""
         ids = ids_fn()
@@ -381,9 +424,14 @@ def make_round_fn(cfg: Config,
         # global sums the reference's atomics would show (simulator.go:224-230).
         win_mk = sum_fn(win_mk)
         win_bk = sum_fn(win_bk)
+        # Spill pass-through: non-spilling delivery paths return None and
+        # the state keeps its (always-empty) buffers; `spill0` supplies
+        # them as an (mk, bk) tuple.
+        mk_spill = mk_sp if mk_sp is not None else spill0[0]
+        bk_spill = bk_sp if bk_sp is not None else spill0[1]
         return OverlayState(
             friends=friends, friend_cnt=cnt, mk_dst=mk_em, bk_dst=bk_em,
-            boot_dst=boot_em,
+            boot_dst=boot_em, mk_spill=mk_spill, bk_spill=bk_spill,
             round=round_ + 1,
             makeups=makeups0 + win_mk, breakups=breakups0 + win_bk,
             win_makeups=win_mk, win_breakups=win_bk,
@@ -391,15 +439,17 @@ def make_round_fn(cfg: Config,
         )
 
     def round_fn(st: OverlayState, base_key: jax.Array) -> OverlayState:
-        bk_mbox, n_bk, drop2 = p_bk_deliver(st.bk_dst)
+        bk_mbox, n_bk, drop2, bk_sp = p_bk_deliver(st.bk_dst, st.bk_spill)
         friends, cnt, mk_em, win_bk = p_bk_process(
             st.friends, st.friend_cnt, bk_mbox, n_bk, drop2, st.round,
             base_key)
-        mk_mbox, n_mk, drop1, friends, cnt = p_mk_deliver(
-            st.mk_dst, st.boot_dst, friends, cnt, win_bk)
+        mk_mbox, n_mk, drop1, friends, cnt, mk_sp = p_mk_deliver(
+            st.mk_dst, st.boot_dst, st.mk_spill, friends, cnt, win_bk)
         return p_mk_process(
             mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em, win_bk,
-            st.round, st.makeups, st.breakups, st.mailbox_dropped, base_key)
+            st.round, st.makeups, st.breakups, st.mailbox_dropped, base_key,
+            mk_sp=mk_sp, bk_sp=bk_sp,
+            spill0=(st.mk_spill, st.bk_spill))
 
     # make_split_round_fn's seam.
     round_fn.pieces = (p_bk_deliver, p_bk_process, p_mk_deliver,
@@ -435,20 +485,29 @@ def make_split_round_fn(cfg: Config):
     _, p_bk_process, _, p_mk_process = fused.pieces
     n = cfg.n
     cap = cfg.mailbox_cap_for(n)
-    hosted_deliver = make_hosted_column_delivery(n, cap,
-                                                 delivery_chunk(cfg, n))
+    hosted_deliver = make_hosted_column_delivery(
+        n, cap, delivery_chunk(cfg, n),
+        spill_cap=SPILL_CAP if spill_enabled(cap) else 0)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    # bk_mbox is not donated for the same reason as b2_fn's mk_mbox (no
+    # same-shaped output to alias; liveness frees it after the slot loop).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def a2_fn(friends, cnt, bk_mbox, n_bk, drop2, round_, base_key):
         return p_bk_process(friends, cnt, bk_mbox, n_bk, drop2, round_,
                             base_key)
 
-    @functools.partial(jax.jit, donate_argnums=tuple(range(8)))
+    # mk_mbox is NOT donated (advisor r4: the flat (n*cap+1) mailbox has
+    # no same-shaped output to alias, so donating it only produced the
+    # "donated buffers were not usable" warning -- at n=1e8 it is freed
+    # by liveness right after the slot loop either way); friends/cnt/
+    # mk_em/spills all alias same-shaped state outputs.
+    @functools.partial(jax.jit, donate_argnums=(4, 5, 6, 13, 14))
     def b2_fn(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em, win_bk,
-              round_, makeups0, breakups0, dropped0, base_key):
+              round_, makeups0, breakups0, dropped0, base_key, mk_sp,
+              bk_sp):
         return p_mk_process(mk_mbox, n_mk, drop1, drop2, friends, cnt,
                             mk_em, win_bk, round_, makeups0, breakups0,
-                            dropped0, base_key)
+                            dropped0, base_key, mk_sp=mk_sp, bk_sp=bk_sp)
 
     fence_jit = jax.jit(lambda x: x + 1)
     reshape_boot = jax.jit(lambda b: b[None, :])
@@ -477,24 +536,35 @@ def make_split_round_fn(cfg: Config):
         friends, cnt = st.friends, st.friend_cnt
         mk_dst, boot_dst = st.mk_dst, st.boot_dst
         bk_dst = st.bk_dst
+        mk_spill0, bk_spill0 = st.mk_spill, st.bk_spill
         round_, mk0, bk0, d0 = (st.round, st.makeups, st.breakups,
                                 st.mailbox_dropped)
         del st
-        bk_mbox, n_bk, drop2 = hosted_deliver((bk_dst,))
-        del bk_dst
+        if spill_enabled(cap):
+            bk_mbox, n_bk, drop2, bk_sp = hosted_deliver(
+                (bk_dst,), spill_in=bk_spill0)
+        else:
+            bk_mbox, n_bk, drop2 = hosted_deliver((bk_dst,))
+            bk_sp = bk_spill0  # always-empty pass-through
+        del bk_dst, bk_spill0
         fence()
         friends, cnt, mk_em, win_bk = a2_fn(friends, cnt, bk_mbox, n_bk,
                                             drop2, round_, base_key)
         del bk_mbox
         jax.block_until_ready(friends)
         fence()
-        mk_mbox, n_mk, drop1 = hosted_deliver(
-            (mk_dst, reshape_boot(boot_dst)))
-        del mk_dst, boot_dst
+        if spill_enabled(cap):
+            mk_mbox, n_mk, drop1, mk_sp = hosted_deliver(
+                (mk_dst, reshape_boot(boot_dst)), spill_in=mk_spill0)
+        else:
+            mk_mbox, n_mk, drop1 = hosted_deliver(
+                (mk_dst, reshape_boot(boot_dst)))
+            mk_sp = mk_spill0
+        del mk_dst, boot_dst, mk_spill0
         fence()
         out = b2_fn(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em,
-                    win_bk, round_, mk0, bk0, d0, base_key)
-        del mk_mbox, friends, cnt, mk_em
+                    win_bk, round_, mk0, bk0, d0, base_key, mk_sp, bk_sp)
+        del mk_mbox, friends, cnt, mk_em, mk_sp, bk_sp
         jax.block_until_ready(out.friends)
         fence()
         return out
@@ -526,8 +596,12 @@ class OverlayResult(NamedTuple):
 
 
 def pending_emissions(st: OverlayState) -> jnp.ndarray:
+    # Spilled overflow pairs are in-flight messages (delivered next
+    # round): quiescing while any remain would lose them.
     return ((st.mk_dst >= 0).sum(dtype=I32) + (st.bk_dst >= 0).sum(dtype=I32)
-            + (st.boot_dst >= 0).sum(dtype=I32))
+            + (st.boot_dst >= 0).sum(dtype=I32)
+            + (st.mk_spill[1] >= 0).sum(dtype=I32)
+            + (st.bk_spill[1] >= 0).sum(dtype=I32))
 
 
 def quiesced(st: OverlayState) -> jnp.ndarray:

@@ -112,6 +112,16 @@ class OverlayState(NamedTuple):
     mk_dst: jnp.ndarray  # int32[cap, n]  makeup emissions (dst per slot; src=lane)
     bk_dst: jnp.ndarray  # int32[cap, n]  breakup emissions
     boot_dst: jnp.ndarray  # int32[n]  bootstrap makeups (src=lane)
+    # Mailbox-overflow spill: (src, dst) pairs a full mailbox could not
+    # take this round, re-delivered FIRST next round -- the reference's
+    # channel-full backpressure delays membership traffic, never loses it
+    # (simulator.go:51-54).  -1-padded; beyond-spill-capacity messages
+    # still fall through to mailbox_dropped (counted, never silent).
+    # Filled only on the single-device column-delivery paths (the
+    # flagship-scale regime where overflow was ever observed); the
+    # sharded hook path keeps counted drops.
+    mk_spill: jnp.ndarray  # int32[2, SPILL_CAP(+1)]  overflowed makeups
+    bk_spill: jnp.ndarray  # int32[2, SPILL_CAP(+1)]  overflowed breakups
     round: jnp.ndarray  # int32[]
     makeups: jnp.ndarray  # int32[]  cumulative processed (MakeUps)
     breakups: jnp.ndarray  # int32[]  (BreakUps)

@@ -218,14 +218,22 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
 
 
 def _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
-                        rank_major):
+                        rank_major, spill=None):
     """ONE compaction chunk's delivery: stable sort by key, rank
     continuation via the total-arrivals counter, capacity-checked flat
     scatter (trash cell at nk*cap), count/drop updates.  THE shared body
     behind _deliver_compact_keyed and make_hosted_column_delivery -- the
     split round's bit-identity with the fused delivery is structural,
     not a maintained copy.  `key` must already be nk-sentineled for
-    invalid lanes; `s` is the payload (sender ids)."""
+    invalid lanes; `s` is the payload (sender ids).
+
+    `spill`, when given as `(pairs int32[2, scap + 1], cnt int32[])`,
+    collects capacity-overflowed messages as (src, dst) pairs instead of
+    dropping them -- the caller re-delivers them next round, reproducing
+    the reference's channel-full backpressure (senders block; membership
+    traffic is delayed, never lost -- simulator.go:51-54).  Only messages
+    past the SPILL capacity fall through to `dropped` (counted, never
+    silent).  Returns (mbox, count, dropped[, spill])."""
     sd, ss = jax.lax.sort((key, s.astype(jnp.int32)), num_keys=1,
                           is_stable=True)
     rank = segment_ranks(sd) + count[jnp.minimum(sd, nk)]
@@ -236,13 +244,23 @@ def _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
         flat = jnp.where(ok, sd * cap + rank, nk * cap)
     mbox = mbox.at[flat].set(jnp.where(ok, ss, -1))
     count = count.at[jnp.where(sd < nk, sd, nk)].add(1)
-    dropped = dropped + ((sd < nk) & (rank >= cap)).sum(dtype=jnp.int32)
-    return mbox, count, dropped
+    ovf = (sd < nk) & (rank >= cap)
+    if spill is None:
+        return mbox, count, dropped + ovf.sum(dtype=jnp.int32)
+    pairs, scnt = spill
+    scap = pairs.shape[1] - 1
+    pos = scnt + jnp.cumsum(ovf.astype(jnp.int32)) - 1
+    fit = ovf & (pos < scap)
+    tgt = jnp.where(fit, pos, scap)  # trash column
+    pairs = pairs.at[0, tgt].set(jnp.where(fit, ss, -1))
+    pairs = pairs.at[1, tgt].set(jnp.where(fit, sd, -1))
+    dropped = dropped + (ovf & ~fit).sum(dtype=jnp.int32)
+    return mbox, count, dropped, (pairs, scnt + fit.sum(dtype=jnp.int32))
 
 
 def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
                            src_cols=None, src_mod=None, carry=None,
-                           rank_major=False):
+                           rank_major=False, spill=None):
     """Chunked-compacted delivery on a prepacked key in [0, nk) with nk
     the invalid sentinel -- the ONE chunked work-horse behind
     _deliver_compact (key = dst), deliver_pair (key = typ*n + dst) and
@@ -266,8 +284,11 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
     total = valid.sum(dtype=jnp.int32)
     chunks = (total + chunk - 1) // chunk
 
-    def body(i, carry):
-        mbox, count, dropped, remaining = carry
+    def body(i, bcarry):
+        if spill is not None:
+            mbox, count, dropped, pairs, scnt, remaining = bcarry
+        else:
+            mbox, count, dropped, remaining = bcarry
         idx = first_true_indices(remaining, chunk)
         hit = jnp.zeros((m,), bool).at[idx].set(True, mode="drop")
         remaining = remaining & ~hit
@@ -280,6 +301,11 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
             s = src.at[idx].get(mode="fill", fill_value=-1)
         key = key_full.at[idx].get(mode="fill", fill_value=nk)
         key = jnp.where(v, key, nk)
+        if spill is not None:
+            mbox, count, dropped, (pairs, scnt) = _compact_chunk_step(
+                mbox, count, dropped, key, s, nk, cap, rank_major,
+                spill=(pairs, scnt))
+            return mbox, count, dropped, pairs, scnt, remaining
         mbox, count, dropped = _compact_chunk_step(
             mbox, count, dropped, key, s, nk, cap, rank_major)
         return mbox, count, dropped, remaining
@@ -288,13 +314,36 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
         carry = (jnp.full((nk * cap + 1,), -1, dtype=jnp.int32),
                  jnp.zeros((nk + 1,), dtype=jnp.int32),
                  jnp.zeros((), jnp.int32))
+    if spill is not None:
+        out = jax.lax.fori_loop(0, chunks, body, carry + spill + (valid,))
+        return out[0], out[1], out[2], (out[3], out[4])
     mbox, count, dropped, _ = jax.lax.fori_loop(
         0, chunks, body, carry + (valid,))
     return mbox, count, dropped
 
 
+def deliver_spill_pairs(carry, pairs, n: int, cap: int, rank_major: bool,
+                        spill=None):
+    """Deliver an explicit (src, dst) pair list -- last round's
+    capacity-overflow spill -- as ONE sorted chunk step, chained BEFORE
+    the round's emission matrices through the same carry (delayed
+    messages arrive first, a deterministic order).  `pairs` is
+    int32[2, S(+1)] with -1-padded dst; an all-empty spill costs one
+    S-wide sort.  Re-overflowed messages go into `spill` again (or are
+    counted dropped when spill is None)."""
+    mbox, count, dropped = carry
+    dst = pairs[1]
+    key = jnp.where(dst >= 0, dst, n).astype(jnp.int32)
+    out = _compact_chunk_step(mbox, count, dropped, key, pairs[0], n, cap,
+                              rank_major, spill=spill)
+    if spill is None:
+        return out, None
+    return out[:3], out[3]
+
+
 def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int,
-                    flat: bool = False, carry=None):
+                    flat: bool = False, carry=None, spill_in=None,
+                    spill=None):
     """Per-SLOT chunked delivery of a (slots, n) emission matrix whose
     sender id is the lane (column) index.
 
@@ -322,25 +371,50 @@ def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int,
     through the same carry (the overlay's reply buffers followed by the
     bootstrap vector reshaped (1, n)).  `carry` optionally supplies the
     initial (mbox, count, dropped) -- the overlay passes allocation-
-    sequenced buffers so consecutive deliveries can share memory."""
+    sequenced buffers so consecutive deliveries can share memory.
+
+    `spill_in` (int32[2, S] pairs) delivers last round's overflow spill
+    FIRST through the same carry; `spill` (a (pairs, cnt) accumulator)
+    collects THIS delivery's overflow instead of dropping it (see
+    _compact_chunk_step) -- the return gains the final accumulator."""
     mats = dst_mat if isinstance(dst_mat, (tuple, list)) else (dst_mat,)
+    return _deliver_columns_impl(mats, n, cap, chunk, flat, carry,
+                                 spill_in=spill_in, spill=spill)
+
+
+def _deliver_columns_impl(mats, n, cap, chunk, flat, carry, spill_in=None,
+                          spill=None):
+    if carry is None:
+        carry = (jnp.full((n * cap + 1,), -1, dtype=jnp.int32),
+                 jnp.zeros((n + 1,), dtype=jnp.int32),
+                 jnp.zeros((), jnp.int32))
+    if spill_in is not None:
+        carry, spill = deliver_spill_pairs(carry, spill_in, n, cap,
+                                           rank_major=flat, spill=spill)
     for mat in mats:
         for c in range(mat.shape[0]):
             dcol = mat[c]
             # src_cols=1: the sender id is the lane index itself; the
             # chained carry continues per-node ranks across slots exactly
             # like the chunk continuation within one call.
-            carry = _deliver_compact_keyed(None, dcol, dcol >= 0, n, cap,
-                                           chunk, src_cols=1, carry=carry,
-                                           rank_major=flat)
+            out = _deliver_compact_keyed(None, dcol, dcol >= 0, n, cap,
+                                         chunk, src_cols=1, carry=carry,
+                                         rank_major=flat, spill=spill)
+            if spill is not None:
+                carry, spill = out[:3], out[3]
+            else:
+                carry = out
     mbox, count, dropped = carry
     if flat:
-        return mbox, jnp.minimum(count[:n].max(initial=0), cap), dropped
-    return mbox[:n * cap].reshape(n, cap), dropped
+        res = (mbox, jnp.minimum(count[:n].max(initial=0), cap), dropped)
+    else:
+        res = (mbox[:n * cap].reshape(n, cap), dropped)
+    return res + (spill,) if spill is not None else res
 
 
 def make_hosted_column_delivery(n: int, cap: int, chunk: int,
-                                per_call_chunks: int = 256):
+                                per_call_chunks: int = 256,
+                                spill_cap: int = 0):
     """deliver_columns(flat=True) as a HOST-driven sequence of bounded
     device calls -- the memory-scale overlay's delivery (overlay.
     make_split_round_fn).  One fused delivery of a full emission row is
@@ -354,53 +428,106 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
     Bit-identical to deliver_columns(..., flat=True): same chunk body,
     same ascending-index order, same rank continuation (pinned by the
     split==fused trajectory test).  Returns fn(mats) ->
-    (mbox_flat int32[n*cap + 1] rank-major, max_load, dropped)."""
+    (mbox_flat int32[n*cap + 1] rank-major, max_load, dropped).
+
+    With `spill_cap` > 0: run(mats, spill_in) first re-delivers last
+    round's overflow pairs, every chunk collects overflow into a
+    (2, spill_cap + 1) accumulator instead of dropping (see
+    _compact_chunk_step), and the return gains the final pairs array --
+    the memory-scale overlay's lossless-membership path."""
     count_valid = jax.jit(lambda d: (d >= 0).sum(dtype=jnp.int32))
     finish = jax.jit(
         lambda count: jnp.minimum(count[:n].max(initial=0), cap))
+    spilling = spill_cap > 0
 
-    def _chunk_body(mbox, count, dropped, idx, dcol):
+    def _chunk_body(mbox, count, dropped, idx, dcol, spill=None):
         v = idx < n
         s = jnp.where(v, idx, -1)  # sender = lane index (src_cols=1)
         key = dcol.at[idx].get(mode="fill", fill_value=n)
         key = jnp.where(v, key, n)
         return _compact_chunk_step(mbox, count, dropped, key, s, n, cap,
-                                   rank_major=True)
+                                   rank_major=True, spill=spill)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-    def kstep(mbox, count, dropped, remaining, dcol, trips):
+    @functools.partial(jax.jit,
+                       donate_argnums=(0, 1, 2, 3, 4, 5) if spilling
+                       else (0, 1, 2, 3))
+    def kstep(mbox, count, dropped, *rest):
+        if spilling:
+            pairs, scnt, remaining, dcol, trips = rest
+        else:
+            remaining, dcol, trips = rest
+
         def body(i, carry):
-            mbox, count, dropped, remaining = carry
+            if spilling:
+                mbox, count, dropped, pairs, scnt, remaining = carry
+            else:
+                mbox, count, dropped, remaining = carry
             idx = first_true_indices(remaining, chunk)
             hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
             remaining = remaining & ~hit
+            if spilling:
+                mbox, count, dropped, (pairs, scnt) = _chunk_body(
+                    mbox, count, dropped, idx, dcol, spill=(pairs, scnt))
+                return mbox, count, dropped, pairs, scnt, remaining
             mbox, count, dropped = _chunk_body(mbox, count, dropped, idx,
                                                dcol)
             return mbox, count, dropped, remaining
 
-        return jax.lax.fori_loop(0, trips, body,
-                                 (mbox, count, dropped, remaining))
+        init = ((mbox, count, dropped, pairs, scnt, remaining) if spilling
+                else (mbox, count, dropped, remaining))
+        return jax.lax.fori_loop(0, trips, body, init)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def kstep_dense(mbox, count, dropped, dcol, start, trips):
+    @functools.partial(jax.jit,
+                       donate_argnums=(0, 1, 2, 3, 4) if spilling
+                       else (0, 1, 2))
+    def kstep_dense(mbox, count, dropped, *rest):
         """Fully-valid row (every lane emits -- the bootstrap burst):
         chunks are plain ascending ranges, no compaction scan at all.
         Bit-identical to kstep on an all-true mask (first_true_indices
         of all-true IS the ascending range)."""
+        if spilling:
+            pairs, scnt, dcol, start, trips = rest
+        else:
+            dcol, start, trips = rest
+
         def body(i, carry):
-            mbox, count, dropped = carry
+            if spilling:
+                mbox, count, dropped, pairs, scnt = carry
+            else:
+                mbox, count, dropped = carry
             idx = start + i * chunk + jnp.arange(chunk, dtype=jnp.int32)
             idx = jnp.minimum(idx, n)  # tail: clamp to the n sentinel
+            if spilling:
+                mbox, count, dropped, (pairs, scnt) = _chunk_body(
+                    mbox, count, dropped, idx, dcol, spill=(pairs, scnt))
+                return mbox, count, dropped, pairs, scnt
             return _chunk_body(mbox, count, dropped, idx, dcol)
 
-        return jax.lax.fori_loop(0, trips, body, (mbox, count, dropped))
+        init = ((mbox, count, dropped, pairs, scnt) if spilling
+                else (mbox, count, dropped))
+        return jax.lax.fori_loop(0, trips, body, init)
 
     remaining_jit = jax.jit(lambda d: d >= 0)
 
-    def run(mats):
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+    def kspill_in(mbox, count, dropped, pairs, scnt, spill_pairs):
+        carry, sp = deliver_spill_pairs((mbox, count, dropped),
+                                        spill_pairs, n, cap,
+                                        rank_major=True,
+                                        spill=(pairs, scnt))
+        return carry + sp
+
+    def run(mats, spill_in=None):
         mbox = jnp.full((n * cap + 1,), -1, dtype=jnp.int32)
         count = jnp.zeros((n + 1,), dtype=jnp.int32)
         dropped = jnp.zeros((), jnp.int32)
+        if spilling:
+            pairs = jnp.full((2, spill_cap + 1), -1, dtype=jnp.int32)
+            scnt = jnp.zeros((), jnp.int32)
+            if spill_in is not None:
+                mbox, count, dropped, pairs, scnt = kspill_in(
+                    mbox, count, dropped, pairs, scnt, spill_in)
+                jax.block_until_ready(mbox)
         for mat in mats:
             for c in range(mat.shape[0]):
                 dcol = mat[c]
@@ -414,9 +541,14 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
                     done = 0
                     while done < chunks:
                         t = min(per_call_chunks, chunks - done)
-                        mbox, count, dropped = kstep_dense(
-                            mbox, count, dropped, dcol,
-                            jnp.int32(done * chunk), jnp.int32(t))
+                        if spilling:
+                            mbox, count, dropped, pairs, scnt = kstep_dense(
+                                mbox, count, dropped, pairs, scnt, dcol,
+                                jnp.int32(done * chunk), jnp.int32(t))
+                        else:
+                            mbox, count, dropped = kstep_dense(
+                                mbox, count, dropped, dcol,
+                                jnp.int32(done * chunk), jnp.int32(t))
                         jax.block_until_ready(mbox)
                         done += t
                     continue
@@ -424,12 +556,20 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
                 done = 0
                 while done < chunks:
                     t = min(per_call_chunks, chunks - done)
-                    mbox, count, dropped, remaining = kstep(
-                        mbox, count, dropped, remaining, dcol,
-                        jnp.int32(t))
+                    if spilling:
+                        (mbox, count, dropped, pairs, scnt,
+                         remaining) = kstep(mbox, count, dropped, pairs,
+                                            scnt, remaining, dcol,
+                                            jnp.int32(t))
+                    else:
+                        mbox, count, dropped, remaining = kstep(
+                            mbox, count, dropped, remaining, dcol,
+                            jnp.int32(t))
                     jax.block_until_ready(mbox)
                     done += t
                 del remaining
+        if spilling:
+            return mbox, finish(count), dropped, pairs
         return mbox, finish(count), dropped
 
     return run

@@ -46,9 +46,14 @@ def sim_state_specs() -> SimState:
 
 
 def overlay_state_specs() -> OverlayState:
+    # Spill buffers are per-shard (each shard spills only what ITS routed
+    # delivery overflowed; the hook path never fills them -- overlay.py's
+    # pass-through keeps them empty, so the axis-sharded spec just splits
+    # constant -1 arrays).
     return OverlayState(
         friends=P(AXIS, None), friend_cnt=P(AXIS),
         mk_dst=P(None, AXIS), bk_dst=P(None, AXIS), boot_dst=P(AXIS),
+        mk_spill=P(None, None), bk_spill=P(None, None),
         round=P(), makeups=P(), breakups=P(),
         win_makeups=P(), win_breakups=P(), mailbox_dropped=P(),
     )

@@ -89,50 +89,56 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
         per_new = dw * ncap + ntail
         geom = tree.pop("mail_geom", None)
         s_ckpt = (int(geom[2]) if geom is not None and len(geom) > 2 else 1)
-        if s_ckpt != n_shards:
-            raise ValueError(
-                f"checkpoint mail rings were written over {s_ckpt} shard(s) "
-                f"but this run has {n_shards}; the per-shard layout only "
-                f"restores onto the same shard count (use -backend "
-                f"{'jax' if s_ckpt == 1 else 'sharded'} on "
-                f"{s_ckpt} device(s))")
-        if tuple(tree["mail_cnt"].shape) != (n_shards, dw):
+        if tuple(tree["mail_cnt"].shape) != (s_ckpt, dw):
             raise ValueError(
                 "checkpoint window-ring shape "
-                f"{tuple(tree['mail_cnt'].shape)} does not match this "
-                f"config's ({n_shards}, {dw}); restore with the snapshot's "
-                "-delaylow/-delayhigh")
+                f"{tuple(tree['mail_cnt'].shape)} does not match its "
+                f"{s_ckpt} shard(s) x this config's {dw} windows; restore "
+                "with the snapshot's -delaylow/-delayhigh")
         if "sup_cnt" not in tree:
             # Pre-dup-suppression snapshot (rounds <= 4): no deferred
             # duplicate credits pending.  (Crediting is unconditional in
             # the window step, so restoring a suppress-on snapshot into a
             # suppress-off run -- or vice versa -- stays consistent.)
-            tree["sup_cnt"] = np.zeros((n_shards, dw), np.int32)
+            tree["sup_cnt"] = np.zeros((s_ckpt, dw), np.int32)
         mail_len = int(tree["mail_ids"].shape[0])
         if geom is None:
             # Legacy snapshot without geometry metadata: accept only an
             # exact-layout match (repacking blind would mis-index slots).
-            if mail_len != n_shards * per_new:
+            if n_shards != 1 or mail_len != per_new:
                 raise ValueError(
                     f"checkpoint mail-ring geometry ({mail_len},) does not "
                     f"match this config's ({n_shards * per_new},) and the "
                     "snapshot predates geometry metadata; restore with the "
                     "same -delaylow/-delayhigh/-event-slot-cap/-event-chunk "
-                    "it was written with")
+                    "it was written with, single-device")
         else:
             ocap, ochunk = int(geom[0]), int(geom[1])
             # The tail is derived, not stored: recover it from the actual
             # length (pre-round-5 snapshots have tail == chunk; newer ones
             # ring_tail).  Anything below the chunk contradicts every
             # layout that ever existed.
-            per_old = mail_len // n_shards
+            per_old = mail_len // s_ckpt
             otail = per_old - dw * ocap
-            if mail_len % n_shards or otail < ochunk:
+            if mail_len % s_ckpt or otail < ochunk:
                 raise ValueError(
                     f"checkpoint mail_ids length {mail_len} contradicts "
                     f"its stored geometry (cap={ocap}, chunk={ochunk}, "
-                    f"{n_shards} shard(s))")
-            if per_old != per_new or ocap != ncap:
+                    f"{s_ckpt} shard(s))")
+            if s_ckpt != n_shards:
+                # Shard-count resharding (round 5): decode every in-flight
+                # entry to its GLOBAL destination, re-bucket under the new
+                # shard count, and re-pack in the new geometry.
+                mail2, cnt2, sup2, lost = reshard_mail_rings(
+                    np.asarray(tree["mail_ids"]),
+                    np.asarray(tree["mail_cnt"]),
+                    np.asarray(tree["sup_cnt"]), cfg, s_ckpt, n_shards,
+                    dw, ocap, otail)
+                tree["mail_ids"], tree["mail_cnt"] = mail2, cnt2
+                tree["sup_cnt"] = sup2
+                tree["mail_dropped"] = np.asarray(
+                    tree["mail_dropped"]) + np.int32(lost)
+            elif per_old != per_new or ocap != ncap:
                 old = np.asarray(tree["mail_ids"])
                 cnt = np.asarray(tree["mail_cnt"])
                 mails, cnts, lost = [], [], 0
@@ -188,6 +194,41 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
     n, k = (int(d) for d in tree["friends"].shape)
     if n != cfg.n:
         raise ValueError(f"checkpoint has n={n} but this run has n={cfg.n}")
+    if ckpt_mode == "rounds":
+        from gossip_simulator_tpu.models import overlay as _ov
+
+        sc = (_ov.SPILL_CAP
+              if _ov.spill_enabled(cfg.mailbox_cap_for(n // n_shards))
+              else 0)
+        if n_shards > 1:
+            # The sharded rounds engine's routed delivery has no spill
+            # path (overlay_state_specs note): live pairs restored onto a
+            # mesh would sit in pending_emissions forever and block
+            # quiescence.  Empty buffers restore fine.
+            for f in ("mk_spill", "bk_spill"):
+                if f in tree and (np.asarray(tree[f])[1] >= 0).any():
+                    raise ValueError(
+                        f"snapshot holds undelivered {f} overflow pairs; "
+                        "the sharded overlay engine cannot deliver them "
+                        "-- finish phase 1 (or at least drain the spill) "
+                        "single-device before resharding")
+        for f in ("mk_spill", "bk_spill"):
+            if f not in tree:
+                # Pre-round-5 snapshot: no overflow spill in flight.
+                tree[f] = np.full((2, sc + 1), -1, np.int32)
+            elif tuple(tree[f].shape) != (2, sc + 1):
+                # Size drift (e.g. SPILL_CAP change or a cap-band move):
+                # re-pad, preserving any in-flight pairs; pairs beyond the
+                # new size would be lost -- reject that instead.
+                old_arr = np.asarray(tree[f])
+                live = old_arr[:, old_arr[1] >= 0]
+                if live.shape[1] > sc:
+                    raise ValueError(
+                        f"checkpoint {f} holds {live.shape[1]} in-flight "
+                        f"pairs but this build's spill capacity is {sc}")
+                pad = np.full((2, sc + 1), -1, np.int32)
+                pad[:, :live.shape[1]] = live
+                tree[f] = pad
     if k != cfg.max_degree:
         raise ValueError(
             f"checkpoint friend lists have capacity {k} but this config's "
@@ -217,6 +258,62 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                 f" wide but this config's mailbox cap gives {cap_mb}; "
                 "restore with the snapshot's -mailbox-cap / device count")
     return tree
+
+
+def reshard_mail_rings(mail: np.ndarray, cnt: np.ndarray, sup: np.ndarray,
+                       cfg, s_old: int, s_new: int, dw: int, ocap: int,
+                       otail: int):
+    """Re-bucket S_old concatenated per-shard mail rings onto S_new shards
+    (models/event.py packing: entry = dst_local * B + off, SIR triggers at
+    trigger_base(n_local) + id * B + off -- both depend on the PER-SHARD
+    row count, so every in-flight entry is decoded to its global
+    destination and re-encoded).  Within a new (shard, slot) entries keep
+    old-shard-major order -- a deterministic re-choice of arrival order
+    within the window, the same class of re-ordering the sharded engine's
+    batch routing already performs.  Deferred duplicate credits (sup_cnt)
+    are only ever summed across shards, so the per-slot totals land on
+    shard 0.  Entries past the new slot capacity are dropped (counted).
+    Returns (mail, cnt, sup, lost) in the new geometry."""
+    from gossip_simulator_tpu.models import event
+
+    n = cfg.n
+    b = event.batch_ticks(cfg)
+    nlo, nln = n // s_old, n // s_new
+    ncap = event.slot_cap(cfg, nln)
+    ntail = event.ring_tail(cfg, nln)
+    per_old, per_new = dw * ocap + otail, dw * ncap + ntail
+    sir = cfg.protocol == "sir"
+    tbo, tbn = event.trigger_base(nlo, b), event.trigger_base(nln, b)
+    new_mail = np.zeros((s_new * per_new,), np.int32)
+    new_cnt = np.zeros((s_new, dw), np.int32)
+    lost = 0
+    for slot in range(dw):
+        segs = []
+        for sh in range(s_old):
+            c = int(cnt[sh, slot])
+            seg = mail[sh * per_old + slot * ocap:
+                       sh * per_old + slot * ocap + c].astype(np.int64)
+            trig = seg >= tbo if sir else np.zeros(seg.shape, bool)
+            base = np.where(trig, seg - tbo, seg)
+            gid = base // b + sh * nlo
+            off = base % b
+            segs.append((gid, off, trig))
+        gid = np.concatenate([s[0] for s in segs])
+        off = np.concatenate([s[1] for s in segs])
+        trig = np.concatenate([s[2] for s in segs])
+        nsh = gid // nln
+        ndl = gid % nln
+        ent = np.where(trig, tbn + ndl * b + off, ndl * b + off)
+        for t in range(s_new):
+            e = ent[nsh == t].astype(np.int32)
+            take = min(len(e), ncap)
+            lost += len(e) - take
+            at = t * per_new + slot * ncap
+            new_mail[at:at + take] = e[:take]
+            new_cnt[t, slot] = take
+    new_sup = np.zeros((s_new, dw), np.int32)
+    new_sup[0] = sup.astype(np.int64).sum(axis=0)
+    return new_mail, new_cnt, new_sup, lost
 
 
 def repack_mail_ring(mail: np.ndarray, cnt: np.ndarray, ocap: int,

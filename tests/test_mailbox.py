@@ -304,3 +304,104 @@ def test_hosted_column_delivery_matches_fused():
                                   np.asarray(want_mbox))
     assert (int(got_load), int(got_drop)) == (int(want_load),
                                               int(want_drop))
+
+
+def test_spill_makes_overflow_lossless(monkeypatch):
+    """VERDICT r4 #2: mailbox overflow on the column-delivery path spills
+    (src, dst) pairs re-delivered next round -- the reference's
+    channel-full backpressure delays membership traffic, never loses it
+    (simulator.go:51-54).  cap=2 at n=3000 genuinely overflows (the
+    SPILL_CAP=0 control run drops); with the spill the same build
+    finishes with ZERO drops and a full overlay."""
+    import gossip_simulator_tpu.models.overlay as ov
+    from gossip_simulator_tpu.config import Config
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    monkeypatch.setattr(ov, "COLUMN_DELIVERY_MIN_ROWS", 0)
+    cfg = Config(n=3000, graph="overlay", overlay_mode="rounds", fanout=5,
+                 seed=9, backend="jax", progress=False, mailbox_cap=2,
+                 coverage_target=0.9).validate()
+    # Control: spill disabled (capacity 0 -> every overflow falls through
+    # to the counted drop path) -- proves this config overflows at all.
+    monkeypatch.setattr(ov, "SPILL_CAP", 0)
+    ctl = run_simulation(cfg, printer=ProgressPrinter(False))
+    assert ctl.stats.mailbox_dropped > 0
+    monkeypatch.setattr(ov, "SPILL_CAP", 65_536)
+    res = run_simulation(cfg, printer=ProgressPrinter(False))
+    assert res.stats.mailbox_dropped == 0
+    # Overlay invariants still hold: construction quiesced (run_simulation
+    # raises otherwise) with every node at fanout..max_degree friends --
+    # the spilled messages were genuinely delivered, not merely uncounted.
+    import jax
+
+    from gossip_simulator_tpu.backends.jax_backend import JaxStepper
+
+    st = JaxStepper(cfg)
+    st.init()
+    windows, q = st.overlay_run_to_quiescence(20_000)
+    assert q
+    cnt = np.asarray(jax.device_get(st.ostate.friend_cnt
+                                    if st.ostate is not None
+                                    else st.state.friend_cnt))
+    assert (cnt >= cfg.fanout).all()
+    assert (cnt <= cfg.max_degree).all()
+
+
+def test_split_round_identical_to_fused_under_overflow(monkeypatch):
+    """Split (hosted delivery, spill_cap wired) and fused column rounds
+    must stay bit-identical when the mailbox genuinely overflows and the
+    spill engages on both."""
+    import gossip_simulator_tpu.models.overlay as ov
+    from gossip_simulator_tpu.config import Config
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    monkeypatch.setattr(ov, "COLUMN_DELIVERY_MIN_ROWS", 0)
+    cfg = Config(n=3000, graph="overlay", overlay_mode="rounds", fanout=5,
+                 seed=9, backend="jax", progress=False, mailbox_cap=2,
+                 coverage_target=0.9).validate()
+    fused = run_simulation(cfg, printer=ProgressPrinter(False))
+    monkeypatch.setattr(ov, "SPLIT_ROUND_MIN_ROWS", 0)
+    split = run_simulation(cfg, printer=ProgressPrinter(False))
+    assert split.stats == fused.stats
+    assert split.stabilize_ms == fused.stabilize_ms
+    assert fused.stats.mailbox_dropped == 0  # spill engaged, lossless
+
+
+def test_hosted_column_delivery_spill_matches_fused():
+    """deliver_columns(spill=...) and make_hosted_column_delivery(
+    spill_cap=...) must produce identical mailboxes, drops AND spill
+    pairs, including re-delivery of a spill_in list before the rows."""
+    from gossip_simulator_tpu.ops.mailbox import (
+        deliver_columns, make_hosted_column_delivery)
+
+    rng = np.random.default_rng(23)
+    n, cap, chunk, scap = 500, 2, 64, 32
+    rows = [
+        rng.integers(0, n // 20, n),  # heavy collisions -> overflow
+        np.where(rng.random(n) < 0.5, rng.integers(0, n, n), -1),
+    ]
+    mat = jnp.asarray(np.stack(rows).astype(np.int32))
+    spill_in = np.full((2, 40), -1, np.int32)
+    spill_in[0, :10] = rng.integers(0, n, 10)
+    spill_in[1, :10] = rng.integers(0, n // 30, 10)  # collide too
+    spill_in = jnp.asarray(spill_in)
+    acc = (jnp.full((2, scap + 1), -1, jnp.int32), jnp.zeros((), jnp.int32))
+    want_mbox, want_load, want_drop, (want_pairs, want_cnt) = \
+        deliver_columns(mat, n, cap, chunk, flat=True, spill_in=spill_in,
+                        spill=acc)
+    for per_call in (1, 1000):
+        run = make_hosted_column_delivery(n, cap, chunk,
+                                          per_call_chunks=per_call,
+                                          spill_cap=scap)
+        got_mbox, got_load, got_drop, got_pairs = run((mat,),
+                                                      spill_in=spill_in)
+        np.testing.assert_array_equal(np.asarray(got_mbox),
+                                      np.asarray(want_mbox))
+        assert int(got_load) == int(want_load)
+        assert int(got_drop) == int(want_drop)
+        np.testing.assert_array_equal(np.asarray(got_pairs),
+                                      np.asarray(want_pairs))
+    # The spill actually fired in this shape (collision-heavy rows).
+    assert int(want_cnt) > 0
