@@ -269,6 +269,31 @@ def capture_100m_two_phase(detail: dict, seed: int) -> None:
         detail["two_phase_100m"] = {"error": repr(e)}
 
 
+def capture_scale50(detail: dict, seed: int) -> None:
+    """Flagship-adjacent rows for the beyond-parity protocols (VERDICT r4
+    #7): event-engine SIR and push-pull at 50M on one chip.  SIR runs the
+    kout graph here -- the BASELINE config-4 Erdos table at lambda=8 is
+    29 columns wide (5.8 GB at 5e7) and does not fit a 16 GB chip next to
+    the SIR-sized mail ring (measured RESOURCE_EXHAUSTED 2026-08-01; the
+    10M suite row keeps the faithful Erdos shape, and the sharded mesh is
+    the path past it).  Push-pull rides the lane-aware call budget
+    (epidemic.run_call_budget) and the protocol's placeholder friends
+    table (graphs.generate)."""
+    for name, cfg in (
+        ("sir_50m_kout", Config(
+            n=50_000_000, fanout=8, graph="kout", protocol="sir",
+            removal_rate=0.2, backend="jax", seed=seed, pallas=True,
+            coverage_target=0.8, progress=False)),
+        ("pushpull_50m_logn", Config(
+            n=50_000_000, fanout=26, protocol="pushpull", graph="kout",
+            backend="jax", seed=seed, progress=False)),
+    ):
+        try:
+            detail[name] = _bench_backend(cfg.validate())
+        except Exception as e:  # record, don't kill the record
+            detail[name] = {"error": repr(e)}
+
+
 def capture_100m(detail: dict, seed: int, headline_n: int) -> None:
     """The 100M single-chip rows (BASELINE.md north-star scale), captured in
     the driver-recorded bench output rather than only in the README.
@@ -381,14 +406,13 @@ def full_suite(seed: int) -> list[dict]:
                                      fanout=23, protocol="pushpull",
                                      graph="kout", backend="jax", seed=seed,
                                      progress=False)),
-        # engine=event: 35.6s vs the ring engine's 41.9s at this config on
-        # v5e (the ring engine pays O(n) per tick; SIR auto still resolves
-        # to ring, this opts in explicitly).
+        # Auto resolves SIR to the event engine since round 5 (the ring
+        # engine paid O(n) per tick: 41.9s vs ~5s here).
         ("sir_10m_erdos", Config(n=10_000_000 // scale, fanout=8,
                                  graph="erdos", protocol="sir",
                                  removal_rate=0.2, backend="jax", seed=seed,
                                  pallas=on_tpu, coverage_target=0.8,
-                                 engine="event", progress=False)),
+                                 progress=False)),
     ]
     out = []
     for name, cfg in runs:
@@ -452,6 +476,7 @@ def main() -> int:
             with open(partial, "w") as fh:
                 json.dump(result, fh)
             capture_sharded_1chip(result["detail"], args.seed)
+            capture_scale50(result["detail"], args.seed)
             # Refresh the salvage so a worker fault in the near-ceiling
             # 100M rows can't discard the just-measured sharded twins.
             with open(partial, "w") as fh:

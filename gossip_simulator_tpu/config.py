@@ -100,9 +100,9 @@ class Config:
     # Epidemic engine (jax + sharded backends): "ring" keeps per-(slot,
     # node) arrival counts (O(n) per tick); "event" keeps per-slot message
     # id-lists (O(arrivals) per tick -- models/event.py and
-    # parallel/event_sharded.py).  "auto" = event for SI in ticks mode on
-    # the jax/sharded backends (unless compact is explicitly set, a
-    # ring-engine request), ring otherwise.
+    # parallel/event_sharded.py).  "auto" = event for SI and (round 5)
+    # SIR in ticks mode on the jax/sharded backends (unless compact is
+    # explicitly set, a ring-engine request), ring otherwise.
     engine: str = "auto"
     # Event engine per-WINDOW-slot message capacity (-1 = auto: see
     # event.slot_cap -- 1.5*n*mean_degree*B/delay_span, bounded by the SI
@@ -121,11 +121,15 @@ class Config:
     # ring (~4.8x of endgame traffic at fanout 6).  Received trajectory
     # and final totals are bit-identical (A/B-tested); per-window
     # total_message attribution shifts up to delayhigh ms earlier in the
-    # JSONL log.  "auto" = on iff the effective crash rate is 0 (which
-    # includes the reference's own default: crashrate 0.001 truncates to
-    # 0 under its 1%-resolution Bernoulli, simulator.go:180); "on" errors
-    # when crash_p > 0 -- per-reception crash draws are keyed by mailbox
-    # position, so removing entries would shift every later draw.
+    # JSONL log.  "auto" = on iff the EFFECTIVE crash rate is 0: that is
+    # crashrate 0, or any crashrate < 0.01 under -compat-reference
+    # (whose 1%-resolution Bernoulli truncates the reference's own
+    # 0.001 default to 0, simulator.go:180).  Plain crashrate 0.001
+    # WITHOUT compat is an exact-float 0.1% crash rate here and keeps
+    # suppression off -- pass -crashrate 0 (or -compat-reference) to
+    # engage it.  "on" errors when crash_p > 0: per-reception crash
+    # draws are keyed by mailbox position, so removing entries would
+    # shift every later draw.
     dup_suppress: str = "auto"
     # Phase-1 overlay timing (graph=overlay): "rounds" batches membership
     # into synchronous rounds, delivering every emission exactly one round
@@ -266,14 +270,17 @@ class Config:
     def engine_resolved(self) -> str:
         """Event engine requires SI/SIR + ticks semantics on the jax or
         sharded backend; everything else uses the ring engine.  Auto picks
-        event only for SI (SIR stays on the proven ring path unless
-        `-engine event` asks for it).  An explicit `-compact on/off` is a
+        event for BOTH SI and SIR (round 5: event SIR runs the BASELINE
+        config-4 shape ~8x faster than ring -- 5.1 vs 42 s at 10M ER --
+        with crash-path-only divergences enumerated in models/event.py and
+        pinned by the vs-ring/determinism/dieout/removal-1==SI tests plus
+        the sir_event golden).  An explicit `-compact on/off` is a
         ring-engine request (the event engine has no dense path to
         compact), so auto honors it."""
         if self.engine == "event":
             return "event"
         if (self.engine == "auto" and self.backend in ("jax", "sharded")
-                and self.protocol == "si"
+                and self.protocol in ("si", "sir")
                 and self.effective_time_mode == "ticks"
                 and self.compact == "auto"):
             return "event"

@@ -499,7 +499,18 @@ def run_call_budget(cfg: Config) -> int:
     1024 cap bounds how long a dead wave can spin before the host-side
     exhaustion check sees it (the single-device event engine also exits on
     its device-side in-flight term; the ring and sharded engines rely on
-    this granularity)."""
+    this granularity).
+
+    Push-pull budgets by LANES, not ticks: one anti-entropy round touches
+    n * 2f peer draws (every node pushes f and pulls f, no wavefront to
+    compact down to), so a round at 5e7 x fanout 26 is ~6 s of device
+    work by itself -- the SI-shaped 3.3e9/n budget (66 rounds) blew the
+    ~10 s axon watchdog (worker UNAVAILABLE, observed 2026-08-01).
+    1.5e9 lanes/call keeps calls in the 2-6 s band across the measured
+    sizes."""
+    if cfg.protocol == "pushpull":
+        return max(1, min(cfg.max_rounds, 1024,
+                          int(1.5e9 // max(1, 2 * cfg.fanout * cfg.n))))
     return max(64, min(cfg.max_rounds, 1024, int(3.3e9 // max(cfg.n, 1))))
 
 

@@ -99,6 +99,36 @@ def emit_chunk(cfg: Config, n_local: int | None = None) -> int:
     return min(slot_cap(cfg, n_local), max(4096, min(262_144, n // 8)))
 
 
+# Slot-major layout band (single-device; module-level so CPU tests can
+# lower it and pin the band trajectory at test n).  Memory scale ONLY:
+# a 4M band was tried 2026-08-01 to chase the 10M <=70s target and LOST
+# (80.0 vs 72.7 s -- per-row emission scans move the same lane volume at
+# settled windows, and the flat-mailbox dynamic-slice reads cost more
+# than the 2-D column reads at 10M); above ~3.2e7 the node-major
+# layouts are outright compile bombs and the band is mandatory.
+TICKS_SLOTMAJOR_MIN_ROWS = 32_000_000
+
+
+def slotmajor(n_rows: int) -> bool:
+    """Memory/perf layout band for THIS engine (single-device only; the
+    sharded hooks' per-shard slices stay node-major): above the band
+    every (n_rows, small) node-major array is a TPU tiling liability --
+    T(8,128) pads the narrow minor dim 16-25x, and the 100M ticks build
+    died at compile on a 51 GB s32[1e8, 5] copy.  The band switches to
+    the layouts the rounds engine adopted in round 4: slot-major
+    (cap, n) emission buffers with per-ROW compaction scans (the full
+    slots*n flat scan was the dominant settled-window cost), a
+    rank-major FLAT stacked mailbox (ops.mailbox.deliver_pair flat=True)
+    whose slots are contiguous dynamic_slices, and per-LANE-keyed
+    bootstrap/emission draws (no (n, fanout) draw matrix).  Emission
+    order becomes slot-major and the draw streams are lane-keyed -- a
+    deterministic re-choice of arrival order and sample, the same move
+    (and the same honesty argument: the reference's own arrival order is
+    goroutine-racy) as the rounds engine's column band; every n below
+    the band is bit-identical to round 4."""
+    return n_rows >= TICKS_SLOTMAJOR_MIN_ROWS
+
+
 def ticks_delivery_chunk(cfg: Config, n_rows: int) -> int:
     """Delivery chunk for THIS engine's slot drain (deliver_pair): its
     per-chunk cost is dominated by the scatters into the stacked
@@ -155,12 +185,28 @@ def init_state(cfg: Config, base_key: jax.Array) -> OverlayTickState:
     cap = slot_cap(cfg)
     ids = jnp.arange(n, dtype=I32)
     kb = _rng.tick_key(base_key, 0, _rng.OP_BOOTSTRAP)
-    # One independent draw per (node, slot), self patched (id+1)%n
-    # (simulator.go:97-100); duplicates allowed, like the reference.
-    w = jax.vmap(lambda kk: jax.random.randint(kk, (f,), 0, n, dtype=I32))(
-        _rng.row_keys(kb, ids))
-    w = jnp.where(w == ids[:, None], (w + 1) % n, w)
-    friends = jnp.full((n, k), -1, I32).at[:, :f].set(w)
+    sm = slotmajor(n)
+    if sm:
+        # Memory band: per-LANE keyed draws, one (n,) column at a time --
+        # never materializing the (n, fanout) draw matrix whose tiled
+        # copy OOM'd the 100M compile (see slotmajor).  friends columns
+        # land via one-hot blends (elementwise on (n, k), the layout the
+        # rounds engine already proves at 1e8).
+        friends = jnp.full((n, k), -1, I32)
+        colsel = jnp.arange(k, dtype=I32)[None, :]
+        for j in range(f):
+            wj = _rng.row_randint(kb, n, ids * f + j, 1)[:, 0]
+            wj = jnp.where(wj == ids, (wj + 1) % n, wj)
+            friends = jnp.where(colsel == j, wj[:, None], friends)
+        w = None
+    else:
+        # One independent draw per (node, slot), self patched (id+1)%n
+        # (simulator.go:97-100); duplicates allowed, like the reference.
+        w = jax.vmap(
+            lambda kk: jax.random.randint(kk, (f,), 0, n, dtype=I32))(
+            _rng.row_keys(kb, ids))
+        w = jnp.where(w == ids[:, None], (w + 1) % n, w)
+        friends = jnp.full((n, k), -1, I32).at[:, :f].set(w)
     cnt = jnp.full((n,), f, I32)
 
     ring_dst = jnp.zeros((dw * cap + 1,), I32)
@@ -183,7 +229,14 @@ def init_state(cfg: Config, base_key: jax.Array) -> OverlayTickState:
         idx = i * chunk + jnp.arange(chunk, dtype=I32)
         valid = idx < flat_n
         src = jnp.where(valid, idx // f, 0)
-        dst = w.reshape(-1).at[jnp.where(valid, idx, 0)].get()
+        if sm:
+            # Re-derive the lane's draw from its key (identical to the
+            # friends column built above) instead of gathering from a
+            # materialized matrix.
+            dst = _rng.row_randint(kb, n, idx, 1)[:, 0]
+            dst = jnp.where(dst == src, (dst + 1) % n, dst)
+        else:
+            dst = w.reshape(-1).at[jnp.where(valid, idx, 0)].get()
         delay = _rng.row_uniform_delay(kd, cfg.delaylow, cfg.delayhigh, idx)
         arrive = delay  # emitted at t=0
         return _append(cfg, ring_dst, ring_pay, ring_cnt, dropped,
@@ -197,9 +250,15 @@ def init_state(cfg: Config, base_key: jax.Array) -> OverlayTickState:
                        ring_cnt=ring_cnt, mailbox_dropped=dropped)
 
 
-def _emit_all(cfg: Config, st_ring, base_key, w, em_dst, em_toff, typ, op):
+def _emit_all(cfg: Config, st_ring, base_key, w, em_dst, em_toff, typ, op,
+              lanes_major: bool = False):
     """Compact an (n, cap_mb) emission buffer and append every entry with a
-    fresh per-message delay drawn at its trigger's arrival tick."""
+    fresh per-message delay drawn at its trigger's arrival tick.
+
+    `lanes_major` is the memory band's SLOT-major (cap_mb, n) buffer
+    layout (see slotmajor): the flat scan order becomes slot-major and
+    the sender id is idx % n -- a band-internal re-choice of emission
+    order, like the rounds engine's column path."""
     ring_dst, ring_pay, ring_cnt, dropped = st_ring
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
@@ -212,28 +271,57 @@ def _emit_all(cfg: Config, st_ring, base_key, w, em_dst, em_toff, typ, op):
     chunk = min(emit_chunk(cfg), flat_n)
     kd = _rng.tick_key(base_key, w, op)
 
-    def body(_, carry):
-        ring_dst, ring_pay, ring_cnt, dropped, remaining = carry
-        idx = first_true_indices(remaining, chunk)
-        hit = jnp.zeros((flat_n,), bool).at[idx].set(True, mode="drop")
-        remaining = remaining & ~hit
-        ok = idx < flat_n
-        src = jnp.where(ok, idx // cols, 0)
-        dst = dflat.at[idx].get(mode="fill", fill_value=-1)
-        toff = tflat.at[idx].get(mode="fill", fill_value=0)
-        valid = dst >= 0
-        # Row-keyed by flat emission index: deterministic and independent
-        # regardless of chunking.
-        delay = _rng.row_uniform_delay(kd, cfg.delaylow, cfg.delayhigh, idx)
-        arrive = w * b + toff + delay
-        ring_dst, ring_pay, ring_cnt, dropped = _append(
-            cfg, ring_dst, ring_pay, ring_cnt, dropped,
-            jnp.where(valid, dst, 0),
-            (src * 2 + typ) * b + arrive % b,
-            (arrive // b) % dw, valid)
-        return ring_dst, ring_pay, ring_cnt, dropped, remaining
+    def make_body(base_lane, width):
+        def body(_, carry):
+            ring_dst, ring_pay, ring_cnt, dropped, remaining = carry
+            ridx = first_true_indices(remaining, chunk)
+            hit = jnp.zeros((width,), bool).at[ridx].set(True, mode="drop")
+            remaining = remaining & ~hit
+            # first_true_indices pads exhausted lanes to the MASK length
+            # (`width`); in per-row mode base_lane + width is the next
+            # row's first lane, so padding must be masked by ridx, not by
+            # the global bound (a padded lane would otherwise read a real
+            # NEXT-row emission and double-emit it).
+            ok = ridx < width
+            idx = base_lane + ridx  # global lane id (keys the delay draw)
+            idx_g = jnp.where(ok, idx, flat_n)
+            src = jnp.where(ok, idx % cols if lanes_major else idx // cols,
+                            0)
+            dst = dflat.at[idx_g].get(mode="fill", fill_value=-1)
+            toff = tflat.at[idx_g].get(mode="fill", fill_value=0)
+            valid = dst >= 0
+            # Row-keyed by flat emission index: deterministic and
+            # independent regardless of chunking.
+            delay = _rng.row_uniform_delay(kd, cfg.delaylow, cfg.delayhigh,
+                                           idx)
+            arrive = w * b + toff + delay
+            ring_dst, ring_pay, ring_cnt, dropped = _append(
+                cfg, ring_dst, ring_pay, ring_cnt, dropped,
+                jnp.where(valid, dst, 0),
+                (src * 2 + typ) * b + arrive % b,
+                (arrive // b) % dw, valid)
+            return ring_dst, ring_pay, ring_cnt, dropped, remaining
+        return body
 
-    out = jax.lax.fori_loop(0, (total + chunk - 1) // chunk, body,
+    if lanes_major:
+        # Per-ROW compaction (the deliver_columns move): each slot row is
+        # a contiguous n-lane slice, so the scan pays n lanes per chunk
+        # instead of slots*n -- the full flat scan was the dominant
+        # settled-window cost at 10M.  Same entries, same slot-major
+        # order, same lane-keyed draws; rows with zero emissions cost one
+        # n-wide popcount.
+        carry = (ring_dst, ring_pay, ring_cnt, dropped)
+        for r in range(em_dst.shape[0]):
+            rowv = valid_all[r * cols:(r + 1) * cols]
+            rtotal = rowv.sum(dtype=I32)
+            rchunk = min(chunk, cols)
+            carry = jax.lax.fori_loop(
+                0, (rtotal + rchunk - 1) // rchunk,
+                make_body(r * cols, cols), carry + (rowv,))[:4]
+        return carry
+
+    out = jax.lax.fori_loop(0, (total + chunk - 1) // chunk,
+                            make_body(0, flat_n),
                             (ring_dst, ring_pay, ring_cnt, dropped,
                              valid_all))
     return out[:4]
@@ -260,6 +348,9 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
     cap = slot_cap(cfg, n_local)
+    # Memory-band layouts (single-device only: the sharded hooks keep
+    # node-major per-shard slices -- see slotmajor's docstring).
+    sm = slotmajor(n_rows) and emit_fn is None
     # Per-LOCAL-rows cap, matching the sharded caller's emit_routed
     # (overlay_ticks_sharded uses the same stacked cap -- a mixed pair
     # would shape-mismatch the emission buffers past n ~ 1.34e8).
@@ -280,8 +371,9 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
     def _deliver_both(src_pay, dst, typ, evalid):
         # Both message types in ONE sorted pass (ops.mailbox.deliver_pair;
         # bit-identical to two deliver() calls at ~half the op count).
+        # Memory band: rank-major flat stacked buffer + per-type loads.
         return deliver_pair(src_pay, dst, typ, evalid, n_rows, cap_mb,
-                            compact_chunk=dchunk)
+                            compact_chunk=dchunk, flat=sm)
 
     def _drain_at_width(width, ring_dst, ring_pay, slot, m):
         """Drain one window slot through a `width`-lane sort + delivery.
@@ -316,18 +408,36 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         slot = w % dw
         m = st.ring_cnt[0, slot]
         if len(widths) == 1:
-            mk_mbox, bk_mbox, local_drops = _drain_at_width(
-                cap, st.ring_dst, st.ring_pay, slot, m)
+            drained = _drain_at_width(cap, st.ring_dst, st.ring_pay, slot,
+                                      m)
         else:
             # widths descend; ws[0] = cap >= m always, so the last
             # fitting index is count_of_fits - 1.
             sel = (jnp.asarray(widths, dtype=I32) >= m).sum(dtype=I32) - 1
-            mk_mbox, bk_mbox, local_drops = jax.lax.switch(
+            drained = jax.lax.switch(
                 sel,
                 [lambda rd, rp, sl, mm, w_=w_: _drain_at_width(w_, rd, rp,
                                                                sl, mm)
                  for w_ in widths],
                 st.ring_dst, st.ring_pay, slot, m)
+        if sm:
+            # Rank-major flat stacked mailbox: slot r of type t is the
+            # contiguous range [r*2n + t*n, r*2n + (t+1)*n).
+            pair_mbox, n_mk, n_bk, local_drops = drained
+
+            def mk_slot(sl):
+                return jax.lax.dynamic_slice(pair_mbox,
+                                             (sl * 2 * n_rows,), (n_rows,))
+
+            def bk_slot(sl):
+                return jax.lax.dynamic_slice(
+                    pair_mbox, (sl * 2 * n_rows + n_rows,), (n_rows,))
+        else:
+            mk_mbox, bk_mbox, local_drops = drained
+            n_bk = (bk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
+            n_mk = (mk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
+            mk_slot = lambda sl: mk_mbox[:, sl]
+            bk_slot = lambda sl: bk_mbox[:, sl]
         ring_cnt = st.ring_cnt.at[0, slot].set(0)
 
         rkey = key_fn(base_key, w, _rng.OP_REPLACE)
@@ -335,10 +445,19 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         ids = ids_fn()
 
         friends, cnt = st.friends, st.friend_cnt
-        mk_em_dst = jnp.full((n_rows, cap_mb), -1, I32)
-        mk_em_toff = jnp.zeros((n_rows, cap_mb), I32)
-        bk_em_dst = jnp.full((n_rows, cap_mb), -1, I32)
-        bk_em_toff = jnp.zeros((n_rows, cap_mb), I32)
+        # Memory band: SLOT-major emission buffers (node axis minormost;
+        # the node-major form tile-pads 16x at 1e8 -- see slotmajor).
+        em_shape = (cap_mb, n_rows) if sm else (n_rows, cap_mb)
+        mk_em_dst = jnp.full(em_shape, -1, I32)
+        mk_em_toff = jnp.zeros(em_shape, I32)
+        bk_em_dst = jnp.full(em_shape, -1, I32)
+        bk_em_toff = jnp.zeros(em_shape, I32)
+
+        def em_set(em, sl, vals):
+            if sm:
+                return em.at[sl].set(vals)
+            return em.at[:, sl].set(vals)
+
         win_mk = jnp.zeros((), I32)
         win_bk = jnp.zeros((), I32)
 
@@ -348,19 +467,18 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         # the emission so the reply's delay starts at the right time.
         def bk_body(sl, carry):
             friends, cnt, mk_em_dst, mk_em_toff, win_bk = carry
-            pay = bk_mbox[:, sl]
+            pay = bk_slot(sl)
             has = pay >= 0
             src = jnp.where(has, pay // b, 0)
             toff = jnp.where(has, pay % b, 0)
             kk = jax.random.fold_in(rkey, sl)
             friends, cnt, nf, rp = process_breakup_slot(
                 n, fanout, friends, cnt, src, has, ids, kk)
-            mk_em_dst = mk_em_dst.at[:, sl].set(jnp.where(rp, nf, -1))
-            mk_em_toff = mk_em_toff.at[:, sl].set(toff)
+            mk_em_dst = em_set(mk_em_dst, sl, jnp.where(rp, nf, -1))
+            mk_em_toff = em_set(mk_em_toff, sl, toff)
             return (friends, cnt, mk_em_dst, mk_em_toff,
                     win_bk + has.sum(dtype=I32))
 
-        n_bk = (bk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
         friends, cnt, mk_em_dst, mk_em_toff, win_bk = jax.lax.fori_loop(
             0, n_bk, bk_body,
             (friends, cnt, mk_em_dst, mk_em_toff, win_bk))
@@ -368,29 +486,34 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         # --- makeups (simulator.go:66-75) ----------------------------------
         def mk_body(sl, carry):
             friends, cnt, bk_em_dst, bk_em_toff, win_mk = carry
-            pay = mk_mbox[:, sl]
+            pay = mk_slot(sl)
             has = pay >= 0
             src = jnp.where(has, pay // b, 0)
             toff = jnp.where(has, pay % b, 0)
             kk = jax.random.fold_in(ekey, sl)
             friends, cnt, victim, ev = process_makeup_slot(
                 fanin, friends, cnt, src, has, kk)
-            bk_em_dst = bk_em_dst.at[:, sl].set(jnp.where(ev, victim, -1))
-            bk_em_toff = bk_em_toff.at[:, sl].set(toff)
+            bk_em_dst = em_set(bk_em_dst, sl, jnp.where(ev, victim, -1))
+            bk_em_toff = em_set(bk_em_toff, sl, toff)
             return (friends, cnt, bk_em_dst, bk_em_toff,
                     win_mk + has.sum(dtype=I32))
 
-        n_mk = (mk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
         friends, cnt, bk_em_dst, bk_em_toff, win_mk = jax.lax.fori_loop(
             0, n_mk, mk_body,
             (friends, cnt, bk_em_dst, bk_em_toff, win_mk))
 
         # --- emissions -> ring, per-message delays -------------------------
         ring = (st.ring_dst, st.ring_pay, ring_cnt, local_drops)
-        ring = emit_fn(ring, base_key, w, mk_em_dst, mk_em_toff,
-                       MK, _rng.OP_DELAY)
-        ring = emit_fn(ring, base_key, w, bk_em_dst, bk_em_toff,
-                       BK, _rng.OP_DELAY_BK)
+        if sm:
+            ring = _emit_all(cfg, ring, base_key, w, mk_em_dst, mk_em_toff,
+                             MK, _rng.OP_DELAY, lanes_major=True)
+            ring = _emit_all(cfg, ring, base_key, w, bk_em_dst, bk_em_toff,
+                             BK, _rng.OP_DELAY_BK, lanes_major=True)
+        else:
+            ring = emit_fn(ring, base_key, w, mk_em_dst, mk_em_toff,
+                           MK, _rng.OP_DELAY)
+            ring = emit_fn(ring, base_key, w, bk_em_dst, bk_em_toff,
+                           BK, _rng.OP_DELAY_BK)
         ring_dst, ring_pay, ring_cnt, local_drops = ring
 
         win_mk = sum_fn(win_mk)

@@ -178,7 +178,7 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
 
 
 def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
-                 compact_chunk: int | None = None):
+                 compact_chunk: int | None = None, flat: bool = False):
     """Deliver a two-TYPE message stream into two mailbox sets in ONE
     sorted pass: key (typ, dst) packed as typ*n + dst, shared compaction,
     one stable sort, one scatter into a stacked [2n, cap] buffer split
@@ -191,28 +191,48 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
 
     Requires flat addressing for the stacked buffer, (2n+1)*cap < 2^31;
     past that it falls back to two deliver() calls (which carry their own
-    dense-fallback warning).  Returns (mbox_t0, mbox_t1, dropped)."""
+    dense-fallback warning).  Returns (mbox_t0, mbox_t1, dropped).
+
+    With `flat` (the ticks engine's memory band): never materializes the
+    (n, cap) 2-D shapes, whose narrow minor dim TPU tiling pads 16-25x
+    (the round-4/5 compile-OOM class) -- returns the RANK-MAJOR stacked
+    buffer instead: (mbox int32[2n*cap + 1], load_t0, load_t1, dropped),
+    where mailbox slot r of type t is the CONTIGUOUS range
+    [r*2n + t*n, r*2n + (t+1)*n) and load_t* are the max per-node counts
+    (clamped to cap).  Cell contents are identical to the 2-D form."""
+    m = src.shape[0]
+    n2 = 2 * n
     if not flat_addressing_fits(2 * n + 1, cap):
+        assert not flat, "flat deliver_pair requires stacked addressing"
         m0, _, d0 = deliver(src, dst, evalid & (typ == 0), n, cap,
                             compact_chunk)
         m1, _, d1 = deliver(src, dst, evalid & (typ == 1), n, cap,
                             compact_chunk)
         return m0, m1, d0 + d1
-    m = src.shape[0]
-    n2 = 2 * n
     key_full = jnp.where(evalid, typ * n + dst, n2).astype(jnp.int32)
     if compact_chunk is not None and compact_chunk < m:
-        mbox, _, dropped = _deliver_compact_keyed(
-            src, key_full, evalid, n2, cap, compact_chunk)
+        mbox, count, dropped = _deliver_compact_keyed(
+            src, key_full, evalid, n2, cap, compact_chunk,
+            rank_major=flat)
     else:
         sd, ss = jax.lax.sort((key_full, src.astype(jnp.int32)),
                               num_keys=1, is_stable=True)
         rank = segment_ranks(sd)
         ok = (sd < n2) & (rank < cap)
-        flat = jnp.where(ok, sd * cap + rank, n2 * cap)
+        if flat:
+            fidx = jnp.where(ok, rank * n2 + sd, n2 * cap)
+        else:
+            fidx = jnp.where(ok, sd * cap + rank, n2 * cap)
         mbox = jnp.full((n2 * cap + 1,), -1, dtype=jnp.int32)
-        mbox = mbox.at[flat].set(jnp.where(ok, ss, -1))[:n2 * cap]
+        mbox = mbox.at[fidx].set(jnp.where(ok, ss, -1))
+        count = jnp.zeros((n2 + 1,), dtype=jnp.int32).at[
+            jnp.where(sd < n2, sd, n2)].add(1)
         dropped = ((sd < n2) & (rank >= cap)).sum(dtype=jnp.int32)
+    if flat:
+        return (mbox,
+                jnp.minimum(count[:n].max(initial=0), cap),
+                jnp.minimum(count[n:n2].max(initial=0), cap), dropped)
+    mbox = mbox[:n2 * cap]
     return (mbox[:n * cap].reshape(n, cap),
             mbox[n * cap:n2 * cap].reshape(n, cap), dropped)
 

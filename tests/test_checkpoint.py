@@ -106,8 +106,11 @@ def test_sharded_event_resume_reproduces_trajectory(tmp_path):
 
 def test_sharded_ring_resume_reproduces_trajectory(tmp_path):
     """Same round-trip discipline on the ring engine (SIR resolves to it)."""
+    # engine="ring" explicitly: auto-SIR resolves to the event engine
+    # since round 5, and this test exists to cover the RING resume path.
     cfg = Config(n=4000, backend="sharded", graph="kout", fanout=6, seed=3,
-                 protocol="sir", removal_rate=0.3, progress=False).validate()
+                 protocol="sir", removal_rate=0.3, engine="ring",
+                 progress=False).validate()
     assert cfg.engine_resolved == "ring"
     s = _sharded(cfg)
     s.seed()

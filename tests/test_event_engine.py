@@ -40,9 +40,17 @@ def _run_windowed(**kw):
 
 def test_auto_engine_selection():
     assert Config(**BASE).validate().engine_resolved == "event"
+    # SIR rides the event engine by default since round 5 (8x at the
+    # BASELINE config-4 shape; crash-path-only divergence envelope).
     assert Config(**{**BASE, "protocol": "sir"}).validate() \
-        .engine_resolved == "ring"
+        .engine_resolved == "event"
+    assert Config(**{**BASE, "protocol": "sir",
+                     "backend": "sharded", "n": 4000}).validate() \
+        .engine_resolved == "event"
     assert Config(**{**BASE, "time_mode": "rounds"}).validate() \
+        .engine_resolved == "ring"
+    assert Config(**{**BASE, "protocol": "sir",
+                     "time_mode": "rounds"}).validate() \
         .engine_resolved == "ring"
     assert Config(**{**BASE, "backend": "sharded", "n": 4000}).validate() \
         .engine_resolved == "event"
@@ -51,12 +59,8 @@ def test_auto_engine_selection():
     # Explicit compact is a ring-engine request.
     assert Config(**{**BASE, "compact": "on"}).validate() \
         .engine_resolved == "ring"
-    # SIR runs on the event engine only by explicit request.
-    assert Config(**{**BASE, "engine": "event", "protocol": "sir"}) \
-        .validate().engine_resolved == "event"
-    assert Config(**{**BASE, "engine": "event", "protocol": "sir",
-                     "backend": "sharded"}).validate() \
-        .engine_resolved == "event"
+    assert Config(**{**BASE, "compact": "on", "protocol": "sir"}) \
+        .validate().engine_resolved == "ring"
     with pytest.raises(ValueError, match="engine=event"):
         Config(**{**BASE, "engine": "event",
                   "protocol": "pushpull"}).validate()

@@ -92,3 +92,20 @@ def test_compat_reference_seconds_rendering_byte_exact():
                    "-compat-reference", "-delaylow", "500",
                    "-delayhigh", "1000", "-quiet")
     assert out == _golden("compat_seconds.txt")
+
+
+def test_sir_event_auto_byte_exact():
+    """SIR's DEFAULT engine surface (auto resolves to the event engine
+    since round 5): kout graph, per-window coverage lines, final totals.
+    Pins both the promotion itself (a silent fall-back to ring would
+    change the trajectory) and the event-SIR physics at the CLI.
+    Regenerate with:
+    PALLAS_AXON_POOL_IPS="" JAX_PLATFORMS=cpu \
+    python -m gossip_simulator_tpu -n 1500 -backend jax -graph kout \
+    -protocol sir -removal-rate 0.3 -fanout 6 -seed 4 \
+    -coverage-target 0.9 > tests/golden/sir_event.txt
+    """
+    out = _run_cli("-n", "1500", "-backend", "jax", "-graph", "kout",
+                   "-protocol", "sir", "-removal-rate", "0.3",
+                   "-fanout", "6", "-seed", "4", "-coverage-target", "0.9")
+    assert out == _golden("sir_event.txt")

@@ -251,3 +251,39 @@ def test_adaptive_drain_width_identical(monkeypatch):
     adapt_res = run_simulation(cfg, printer=ProgressPrinter(False))
     assert adapt_res.stats == base_res.stats
     assert adapt_res.stabilize_ms == base_res.stabilize_ms
+
+
+def test_slotmajor_band_small_n(monkeypatch):
+    """Pin the memory-band layouts of the ticks engine (overlay_ticks.
+    slotmajor: slot-major emission buffers, rank-major flat stacked
+    mailbox, lane-keyed bootstrap draws) -- the band production only
+    reaches at n >= 3.2e7, where the node-major layouts tile-pad to
+    51 GB at compile.  Lowering the band constant routes a 2000-node
+    build through the exact large-n code path; the pinned trajectory is
+    the band's own (lane-keyed draws differ from node-keyed by design --
+    the node-major path gives 24 windows / 240 ms at this seed too, but
+    different message totals).  The forced cap-8 mailbox genuinely
+    overflows at this shape; ticks-mode overflow stays COUNTED (the
+    lossless spill is the rounds engine's; divergence table in README)."""
+    import jax
+
+    import gossip_simulator_tpu.config as config_mod
+    from gossip_simulator_tpu.backends.jax_backend import JaxStepper
+    from gossip_simulator_tpu.models import overlay_ticks as ot
+
+    monkeypatch.setattr(ot, "TICKS_SLOTMAJOR_MIN_ROWS", 1000)
+    monkeypatch.setattr(config_mod, "MAILBOX_CAP_MEMORY_BAND", 1000)
+    cfg = Config(n=2000, graph="overlay", overlay_mode="ticks",
+                 backend="jax", fanout=5, seed=9, progress=False,
+                 coverage_target=0.9).validate()
+    assert ot.slotmajor(cfg.n)
+    s = JaxStepper(cfg)
+    s.init()
+    windows, q = s.overlay_run_to_quiescence(20_000)
+    assert bool(q)
+    assert windows == 24
+    assert s._stabilize_ms == 240.0
+    cnt = np.asarray(jax.device_get(s.state.friend_cnt))
+    assert (cnt >= cfg.fanout).all()
+    assert (cnt <= cfg.max_degree).all()
+    assert s._mailbox_dropped == 246  # counted, never silent
