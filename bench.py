@@ -78,7 +78,7 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
     stats = s.run_to_target()
     run_s = time.perf_counter() - t0
     ticks = stats.round
-    return {
+    out = {
         "n": cfg.n, "backend": cfg.backend, "devices": jax.device_count(),
         "ticks": ticks, "run_s": run_s,
         "graph_s": graph_s, "graph_gen_s": graph_gen_s,
@@ -86,8 +86,25 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
         "ns_per_message": (run_s * 1e9 / stats.total_message
                            if stats.total_message else None),
         "node_updates_per_sec": cfg.n * ticks / run_s if run_s > 0 else 0.0,
+        "messages_per_sec": (stats.total_message / run_s
+                             if run_s > 0 else 0.0),
         "converged": stats.coverage >= cfg.coverage_target,
     }
+    # Device-resident telemetry rides the timed run for free (the history
+    # writes are scalar ops inside the jitted loop): the phase ledger --
+    # init / compile (first bounded call, warm run) / execute / fetch --
+    # and the per-window count make the perf trajectory self-documenting
+    # in the BENCH record.
+    telem = getattr(s, "_telem", None)
+    if telem is not None:
+        out["phases_s"] = {k: round(v, 4)
+                           for k, v in sorted(telem.phases.items())}
+        hist = telem.gossip_snapshot()
+        if hist:
+            out["windows"] = hist["count"]
+            out["mail_high_water"] = int(hist["cols"][:hist["count"], 6]
+                                         .max(initial=0))
+    return out
 
 
 def _bench_jax(cfg: Config) -> dict:
@@ -253,7 +270,10 @@ def capture_100m_two_phase(detail: dict, seed: int) -> None:
                  progress=False).validate()
     t0 = time.perf_counter()
     try:
-        res = run_simulation(cfg, printer=ProgressPrinter(False))
+        # Context-managed printer: closed even if the near-ceiling run
+        # faults (metrics.ProgressPrinter.__exit__).
+        with ProgressPrinter(False) as printer:
+            res = run_simulation(cfg, printer=printer)
         detail["two_phase_100m"] = {
             "n": cfg.n, "overlay_mode": cfg.overlay_mode_resolved,
             "overlay_windows": res.overlay_windows,

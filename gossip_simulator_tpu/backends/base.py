@@ -102,7 +102,15 @@ def run_bounded_to_target(stepper) -> Stats:
     exhaustion (nothing in flight -- the liveness bound the reference lacks,
     simulator.go:243-251).  Requires `stepper._run_fn(state, key, target,
     until) -> state` with donated state, plus `.state/.key/.exhausted`.
+
+    With a TelemetrySession on the stepper (`stepper._telem`, see
+    utils/telemetry.py) the run fn additionally threads the device-resident
+    per-window History through the loop -- `_run_fn(state, key, target,
+    until, hist) -> (state, hist)` -- and the per-call wall clock lands in
+    the session's phase ledger (first call = compile, rest = execute).
     """
+    import time
+
     import jax
     import numpy as np
 
@@ -112,18 +120,34 @@ def run_bounded_to_target(stepper) -> Stats:
     target = int(np.ceil(cfg.coverage_target * cfg.n))
     budget = epidemic.run_call_budget(cfg)
     tick = int(jax.device_get(stepper.state.tick))
+    telem = getattr(stepper, "_telem", None)
+    hist = telem.begin_gossip() if telem is not None else None
     while True:
         until = min(cfg.max_rounds, tick + budget)
-        stepper.state = stepper._run_fn(stepper.state, stepper.key,
-                                        np.int32(target), np.int32(until))
+        t0 = time.perf_counter()
+        if hist is not None:
+            stepper.state, hist = stepper._run_fn(
+                stepper.state, stepper.key, np.int32(target),
+                np.int32(until), hist)
+        else:
+            stepper.state = stepper._run_fn(stepper.state, stepper.key,
+                                            np.int32(target), np.int32(until))
         st = stepper.state
         from gossip_simulator_tpu.models.event import in_flight as _inflight
 
         tick, recv, in_flight = (int(x) for x in jax.device_get(
             (st.tick, st.total_received, _inflight(st))))
-        if recv >= target or tick >= cfg.max_rounds:
-            break
+        if telem is not None:
+            telem.tally_gossip_call(time.perf_counter() - t0)
+        # Exhaustion is recorded whatever ends the run (the windowed loop's
+        # per-window flag ends up reflecting the LAST window too), so a wave
+        # that dies in the same window the round cap is hit still reports
+        # "exhausted" -- reason parity with the windowed path.
         if in_flight == 0 and cfg.protocol != "pushpull":
             stepper.exhausted = True
+        if (recv >= target or tick >= cfg.max_rounds
+                or stepper.exhausted):
             break
+    if telem is not None:
+        telem.end_gossip(hist)
     return stepper.stats()

@@ -158,6 +158,7 @@ class CppStepper(Stepper):
             total_received=int(buf[0]), total_message=int(buf[1]),
             total_crashed=int(buf[2]), makeups=int(buf[3]),
             breakups=int(buf[4]), total_removed=int(buf[6]),
+            exhausted=self._exhausted,
         )
 
     def sim_time_ms(self) -> float:
@@ -223,7 +224,7 @@ class CppMtStepper(Stepper):
         return Stats(
             n=self.cfg.n, round=int(self.sim_time_ms()),
             total_received=int(buf[0]), total_message=int(buf[1]),
-            total_crashed=int(buf[2]),
+            total_crashed=int(buf[2]), exhausted=self._exhausted,
         )
 
     def sim_time_ms(self) -> float:

@@ -33,6 +33,12 @@ class JaxStepper(Stepper):
             if cfg.effective_time_mode == "ticks" else 1.0)
         self._overlay_rounds = 0
         self.exhausted = False
+        if cfg.telemetry_enabled:
+            from gossip_simulator_tpu.utils.telemetry import TelemetrySession
+
+            self._telem = TelemetrySession(cfg)
+        else:
+            self._telem = None
         if cfg.resume:
             # State arrives via load_state_pytree; building a graph (or the
             # phase-1 overlay buffers) here would be thrown away -- minutes
@@ -48,7 +54,8 @@ class JaxStepper(Stepper):
         self._seed_fn = jax.jit(self._engine.make_seed_fn(cfg))
         self._window = 1 if cfg.effective_time_mode == "rounds" else WINDOW_MS
         self._window_fn = self._engine.make_window_fn(cfg, self._window)
-        self._run_fn = self._engine.make_run_to_coverage_fn(cfg)
+        self._run_fn = self._engine.make_run_to_coverage_fn(
+            cfg, telemetry=self._telem is not None)
         self._mailbox_dropped = 0
 
     # --- phase 1 ---------------------------------------------------------------
@@ -135,45 +142,72 @@ class JaxStepper(Stepper):
         (windows_run, quiesced)."""
         if self._overlay_done:
             return 0, True
+        import time
+
+        telem = self._telem
         if getattr(self, "_osplit", False):
             # Split-round mode (memory scale): the bounded device-side
             # while_loop would re-fuse the round into one program and
             # re-create the OOM; run the host loop instead -- a round is
             # seconds of device work at this n, so the per-round
-            # dispatch + quiescence sync is noise.
+            # dispatch + quiescence sync is noise.  Telemetry records
+            # host-side here, riding the per-round device_get the split
+            # already pays.
             oq = self._quiesced_jit()
             q = False
             while self._overlay_rounds < max_windows:
+                t0 = time.perf_counter()
                 self._advance_overlay()
                 self._overlay_rounds += 1
                 self._phase1_ms = self._overlay_rounds * self._mean_delay
-                q = bool(jax.device_get(oq(self.ostate)))
+                if telem is not None:
+                    st = self.ostate
+                    q, mk, bk, dr = jax.device_get(
+                        (oq(st), st.win_makeups, st.win_breakups,
+                         st.mailbox_dropped))
+                    telem.overlay_host_row(
+                        [self._overlay_rounds, int(mk), int(bk), int(dr)])
+                    telem.tally_overlay_call(time.perf_counter() - t0)
+                    q = bool(q)
+                else:
+                    q = bool(jax.device_get(oq(self.ostate)))
                 if q:
                     break
             if q:
                 self._finish_overlay()
             return self._overlay_rounds, q
         if self._orun is None:
-            self._orun = self._omod.make_run_fn(self.cfg)
+            self._orun = self._omod.make_run_fn(
+                self.cfg, telemetry=telem is not None)
         if budget is None:
             # Watchdog-bounded windows per device call; the calibration
             # lives with each overlay module's cost model.
             budget = self._omod.run_call_budget(self.cfg)
+        hist = telem.begin_overlay(max_windows) if telem is not None else None
         q = False
         while True:
             lim = min(budget, max_windows - self._overlay_rounds)
             if lim <= 0:
                 break
-            self.ostate, polls, q = self._orun(self.ostate, self.key,
-                                               np.int32(lim))
+            t0 = time.perf_counter()
+            if hist is not None:
+                self.ostate, polls, q, hist = self._orun(
+                    self.ostate, self.key, np.int32(lim), hist)
+            else:
+                self.ostate, polls, q = self._orun(self.ostate, self.key,
+                                                   np.int32(lim))
             faithful = self._faithful_overlay
             tick = self.ostate.tick if faithful else 0
             polls, q, tick = jax.device_get((polls, q, tick))
+            if telem is not None:
+                telem.tally_overlay_call(time.perf_counter() - t0)
             self._overlay_rounds += int(polls)
             self._phase1_ms = (float(tick) if faithful
                                else self._overlay_rounds * self._mean_delay)
             if bool(q):
                 break
+        if hist is not None:
+            telem.end_overlay(hist)
         if bool(q):
             self._finish_overlay()
         return self._overlay_rounds, bool(q)
@@ -199,6 +233,7 @@ class JaxStepper(Stepper):
         self.state = self._window_fn(self.state, self.key)
         stats, in_flight = self._stats_and_inflight()
         self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
+        stats.exhausted = self.exhausted
         return stats
 
     def reset_state(self) -> None:
@@ -216,6 +251,8 @@ class JaxStepper(Stepper):
         friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
         self.state = self._engine.init_state(cfg, friends, cnt)
         self.exhausted = False
+        if self._telem is not None:
+            self._telem.reset_gossip()
 
     def run_to_target(self) -> Stats:
         """Bench fast path: bounded device-side while_loop toward the
@@ -223,6 +260,15 @@ class JaxStepper(Stepper):
         from gossip_simulator_tpu.backends.base import run_bounded_to_target
 
         return run_bounded_to_target(self)
+
+    @property
+    def overlay_clock_scale(self) -> float:
+        """Simulated-ms per recorded overlay clock unit: the tick-faithful
+        engine records true ticks; the rounds engine records round counts
+        estimated at mean_delay ms each (the windowed loop's clock)."""
+        if getattr(self, "_faithful_overlay", False):
+            return 1.0
+        return getattr(self, "_mean_delay", 1.0)
 
     def _stats_and_inflight(self) -> tuple[Stats, int]:
         """All progress-window scalars in ONE host round-trip (each
@@ -239,6 +285,7 @@ class JaxStepper(Stepper):
             total_received=int(tr), total_message=msg64_value(tm),
             total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
+            exhausted=self.exhausted,
         ), int(in_flight)
 
     def stats(self) -> Stats:

@@ -306,6 +306,7 @@ class NativeStepper(Stepper):
             total_removed=int(self.removed.sum()),
             makeups=self.makeups,
             breakups=self.breakups,
+            exhausted=self.exhausted,
         )
 
     def sim_time_ms(self) -> float:

@@ -56,6 +56,13 @@ class ShardedStepper(Stepper):
         self.exhausted = False
         self._mailbox_dropped = 0
         self._window = 1 if cfg.effective_time_mode == "rounds" else WINDOW_MS
+        if cfg.telemetry_enabled:
+            from gossip_simulator_tpu.utils.telemetry import TelemetrySession
+
+            self._telem = TelemetrySession(cfg)
+        else:
+            self._telem = None
+        telem_on = self._telem is not None
         if cfg.engine_resolved == "event":
             from gossip_simulator_tpu.parallel import event_sharded
 
@@ -63,14 +70,14 @@ class ShardedStepper(Stepper):
                 cfg, self.mesh, self._window)
             self._seed_fn = event_sharded.make_seed_fn(cfg, self.mesh)
             self._run_fn = event_sharded.make_run_to_coverage_fn(
-                cfg, self.mesh)
+                cfg, self.mesh, telemetry=telem_on)
             init_fn = event_sharded.make_sharded_event_init
         else:
             self._window_fn = sharded_step.make_window_fn(cfg, self.mesh,
                                                           self._window)
             self._seed_fn = sharded_step.make_seed_fn(cfg, self.mesh)
             self._run_fn = sharded_step.make_run_to_coverage_fn(
-                cfg, self.mesh)
+                cfg, self.mesh, telemetry=telem_on)
             init_fn = sharded_step.make_sharded_init
         if cfg.resume:
             # State arrives via load_state_pytree; building a sharded graph
@@ -157,10 +164,13 @@ class ShardedStepper(Stepper):
         every shard runs the same trip count."""
         if self._overlay_done:
             return 0, True
+        import time
+
+        telem = self._telem
         omod = self._overlay_mod()
         if getattr(self, "_orun", None) is None:
-            self._orun = overlay.make_bounded_run(self._oround,
-                                                  omod.quiesced)
+            self._orun = overlay.make_bounded_run(
+                self._oround, omod.quiesced, telemetry=telem is not None)
         if budget is None:
             # Per-call device work scales with the SHARD slice, so the
             # single-chip watchdog budget stretches by the shard count
@@ -168,20 +178,30 @@ class ShardedStepper(Stepper):
             budget = omod.run_call_budget(self.cfg,
                                           shards=self.mesh.shape[AXIS])
         faithful = getattr(self, "_faithful_overlay", False)
+        hist = telem.begin_overlay(max_windows) if telem is not None else None
         q = False
         while True:
             lim = min(budget, max_windows - self._overlay_rounds)
             if lim <= 0:
                 break
-            self.ostate, polls, q = self._orun(self.ostate, self.key,
-                                               np.int32(lim))
+            t0 = time.perf_counter()
+            if hist is not None:
+                self.ostate, polls, q, hist = self._orun(
+                    self.ostate, self.key, np.int32(lim), hist)
+            else:
+                self.ostate, polls, q = self._orun(self.ostate, self.key,
+                                                   np.int32(lim))
             tick = self.ostate.tick if faithful else 0
             polls, q, tick = jax.device_get((polls, q, tick))
+            if telem is not None:
+                telem.tally_overlay_call(time.perf_counter() - t0)
             self._overlay_rounds += int(polls)
             self._phase1_ms = (float(tick) if faithful
                                else self._overlay_rounds * self._mean_delay)
             if bool(q):
                 break
+        if hist is not None:
+            telem.end_overlay(hist)
         if bool(q):
             self._finish_overlay()
         return self._overlay_rounds, bool(q)
@@ -211,10 +231,11 @@ class ShardedStepper(Stepper):
                 return epidemic.init_state(c, friends, cnt, n_local=n_local)
             out_specs = sharded_step.sim_state_specs()
 
-        fn = jax.shard_map(lambda f, c: build(cfg, f, c), mesh=mesh,
-                           in_specs=(P("nodes", None), P("nodes")),
-                           out_specs=out_specs,
-                           check_vma=False)
+        from gossip_simulator_tpu.parallel.mesh import shard_map
+
+        fn = shard_map(lambda f, c: build(cfg, f, c), mesh=mesh,
+                       in_specs=(P("nodes", None), P("nodes")),
+                       out_specs=out_specs)
         return jax.jit(fn)(self.ostate.friends, self.ostate.friend_cnt)
 
     # --- phase 2 ---------------------------------------------------------------
@@ -229,6 +250,7 @@ class ShardedStepper(Stepper):
         stats = self.stats()
         in_flight = int(jax.device_get(_inflight(self.state)))
         self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
+        stats.exhausted = self.exhausted
         return stats
 
     def reset_state(self) -> None:
@@ -238,12 +260,21 @@ class ShardedStepper(Stepper):
             raise ValueError("reset_state requires a static graph")
         self.state = self._init_fn()
         self.exhausted = False
+        if self._telem is not None:
+            self._telem.reset_gossip()
 
     def run_to_target(self) -> Stats:
         """Bounded device-side while_loop (base.run_bounded_to_target)."""
         from gossip_simulator_tpu.backends.base import run_bounded_to_target
 
         return run_bounded_to_target(self)
+
+    @property
+    def overlay_clock_scale(self) -> float:
+        """See JaxStepper.overlay_clock_scale."""
+        if getattr(self, "_faithful_overlay", False):
+            return 1.0
+        return getattr(self, "_mean_delay", 1.0)
 
     def stats(self) -> Stats:
         from gossip_simulator_tpu.models import event as event_mod
@@ -261,6 +292,7 @@ class ShardedStepper(Stepper):
             total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
             exchange_overflow=int(xo),
+            exhausted=self.exhausted,
         )
 
     def sim_time_ms(self) -> float:

@@ -11,6 +11,7 @@ from typing import Optional
 
 from gossip_simulator_tpu.config import parse_args
 from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
 
 
 def _maybe_reexec_for_cpu(argv: Optional[list[str]]) -> None:
@@ -53,7 +54,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                 kw["process_id"] = cfg.process_id
             jax.distributed.initialize(**kw)
             silent = jax.process_index() != 0
-    result = run_simulation(cfg, silent=silent)
+    # Context-managed printer: the JSONL log is flushed and closed even
+    # when the run raises (metrics.ProgressPrinter.__exit__).
+    with ProgressPrinter(
+            enabled=cfg.progress,
+            jsonl_path=(cfg.log_jsonl or None) if not silent else None,
+            silent=silent) as printer:
+        result = run_simulation(cfg, printer=printer, silent=silent)
     return 0 if result.converged else 2
 
 

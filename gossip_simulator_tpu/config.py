@@ -169,6 +169,16 @@ class Config:
     coordinator: str = ""  # e.g. "host0:1234"
     num_processes: int = -1  # -1 = auto-detect
     process_id: int = -1  # -1 = auto-detect
+    # Device-resident per-window telemetry (utils/telemetry.py): the fast-
+    # path while_loops record the full per-window trajectory on device and
+    # the driver replays it through the printer afterward -- so a progress-
+    # printing or JSONL-logging run takes the fast path whenever
+    # checkpointing is off.  "off" restores the old gating (observing runs
+    # pay the windowed host loop).  jax/sharded backends only; the
+    # discrete-event oracles have no device loop to instrument.
+    telemetry: str = "on"
+    # Print the end-of-run telemetry block (phase breakdown, throughput).
+    telemetry_summary: bool = False
 
     # --- derived --------------------------------------------------------------
     @property
@@ -246,6 +256,13 @@ class Config:
         gates, _Checkpointer._due) -- they drifted when each spelled it
         out (advisor r4)."""
         return bool(self.checkpoint_every and self.checkpoint_dir)
+
+    @property
+    def telemetry_enabled(self) -> bool:
+        """Whether the device-side loops record per-window history (see the
+        `telemetry` field): jax/sharded only -- the oracles' windowed loop
+        IS their only loop."""
+        return self.telemetry != "off" and self.backend in ("jax", "sharded")
 
     @property
     def overlay_mode_resolved(self) -> str:
@@ -387,6 +404,9 @@ class Config:
         if self.dup_suppress not in ("auto", "on", "off"):
             raise ValueError(
                 f"dup_suppress must be auto|on|off, got {self.dup_suppress!r}")
+        if self.telemetry not in ("on", "off"):
+            raise ValueError(
+                f"telemetry must be on|off, got {self.telemetry!r}")
         if self.dup_suppress == "on" and self.crashrate_eff > 0.0:
             raise ValueError(
                 "-dup-suppress on requires an effective crash rate of 0 "
@@ -528,6 +548,15 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-overlay-mode", "--overlay-mode", dest="overlay_mode",
                    choices=("auto", "rounds", "ticks"),
                    default=d.overlay_mode)
+    p.add_argument("-telemetry", "--telemetry", choices=("on", "off"),
+                   default=d.telemetry,
+                   help="device-resident per-window telemetry on fast-path "
+                        "runs (jax/sharded); off restores the windowed "
+                        "host loop for observing runs")
+    p.add_argument("-telemetry-summary", "--telemetry-summary",
+                   dest="telemetry_summary", action="store_true",
+                   help="print the end-of-run telemetry block (phase "
+                        "breakdown, throughput)")
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

@@ -11,11 +11,13 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import time
 from typing import Optional
 
 from gossip_simulator_tpu.backends import make_stepper
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.utils import telemetry as _telemetry
 from gossip_simulator_tpu.utils.metrics import ProgressPrinter, Stats
 
 
@@ -41,10 +43,29 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         enabled=cfg.progress,
         jsonl_path=(cfg.log_jsonl or None) if not silent else None,
         silent=silent)
+    try:
+        return _run(cfg, printer, stepper)
+    finally:
+        # Close on ANY exit so a raised run still flushes the JSONL log.
+        if own_printer:
+            printer.close()
+
+
+def _run(cfg: Config, printer: ProgressPrinter,
+         stepper: Optional[Stepper]) -> RunResult:
     stepper = stepper or make_stepper(cfg)
 
     printer.params(cfg.parameter_dump())
+    t_init = time.perf_counter()
     stepper.init()
+    # The telemetry session (utils/telemetry.py) lets an observing run --
+    # progress lines or JSONL -- take the device-side fast paths anyway:
+    # the jitted loops record the full per-window trajectory on device and
+    # the driver replays it through the SAME printer calls afterward,
+    # producing output byte-identical to the windowed loop's.
+    telem = getattr(stepper, "_telem", None)
+    if telem is not None:
+        telem.add_phase("init_s", time.perf_counter() - t_init)
 
     # --- Resume: from a phase-2 snapshot (skip straight into phase 2) or a
     # phase-1 overlay snapshot (continue construction mid-overlay) -------------
@@ -107,10 +128,13 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         # run has no per-window output, so stabilization can run as bounded
         # device-side while_loops (one host sync per watchdog-bounded call
         # -- overlay_ticks/overlay.run_call_budget windows -- instead of
-        # one dispatch + device_get per 10 simulated ms).  Checkpointing
-        # observes per-window state too, so it takes the windowed loop
-        # (same rule as phase 2's `fast` gate).
-        if (not printer.observing and not cfg.checkpointing_enabled
+        # one dispatch + device_get per 10 simulated ms).  With telemetry,
+        # an OBSERVING run takes the same fast path: the loop records the
+        # per-window membership counts on device and they replay below.
+        # Checkpointing observes per-window state the history cannot carry,
+        # so it keeps the windowed loop (same rule as phase 2's gate).
+        if ((not printer.observing or telem is not None)
+                and not cfg.checkpointing_enabled
                 and hasattr(stepper, "overlay_run_to_quiescence")):
             overlay_windows, oq = stepper.overlay_run_to_quiescence(
                 max_overlay_windows)
@@ -118,6 +142,14 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
                 raise RuntimeError(
                     f"overlay did not stabilize within {max_overlay_windows} "
                     f"windows")
+            # Static graphs quiesce without running a window; the windowed
+            # loop still counts its one (immediately-quiesced) poll, so
+            # match it -- RunResult.overlay_windows is path-independent.
+            overlay_windows = max(overlay_windows, 1)
+            if telem is not None and printer.observing:
+                _telemetry.replay_overlay(
+                    printer, telem.overlay_snapshot(),
+                    clock_scale=getattr(stepper, "overlay_clock_scale", 1.0))
         else:
             while True:
                 makeups, breakups, quiesced = stepper.overlay_window()
@@ -153,20 +185,28 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     gossip_windows = 0
     converged = False
     ckpt = _Checkpointer(cfg, stepper)
-    # Nothing observes per-window state on a quiet, uncheckpointed, unlogged
-    # run, so the whole epidemic can run as bounded device-side while_loops
+    # Nothing on a quiet, uncheckpointed, unlogged run observes per-window
+    # state, so the whole epidemic runs as bounded device-side while_loops
     # with a handful of host syncs total -- the windowed loop below pays a
     # full device->host stats round-trip per 10 simulated ms (~2x wall-clock
-    # at n=1e7 through the TPU tunnel).  Gates on the PRINTER's
-    # observability, not just cfg: a caller-supplied window-printing or
-    # JSONL printer must keep receiving per-window callbacks.
+    # at n=1e7 through the TPU tunnel).  With telemetry, an OBSERVING run
+    # (progress lines or JSONL) takes the fast path too: the device loop
+    # records every poll window's counters and the trajectory replays
+    # through the same printer calls right after -- byte-identical output,
+    # fast-path wall clock.  Checkpointing still needs the real per-window
+    # state, so it keeps the windowed loop.
     fast = (not resumed and not cfg.checkpointing_enabled
-            and not printer.observing
+            and (not printer.observing or telem is not None)
             and hasattr(stepper, "run_to_target"))
     with _maybe_profile(cfg):
         if fast:
             stats = stepper.run_to_target()
-            gossip_windows = -(-stats.round // window_rounds)
+            hist2 = telem.gossip_snapshot() if telem is not None else None
+            if hist2 and printer.observing:
+                _telemetry.replay_gossip(printer, hist2, n=cfg.n)
+            gossip_windows = (hist2["count"]
+                              if hist2 and not hist2["truncated"]
+                              else -(-stats.round // window_rounds))
             converged = stats.coverage >= target
         else:
             while gossip_windows < max_windows:
@@ -187,13 +227,14 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     stats = stepper.stats()
     # A snapshot restored at/after the cap may already be at target.
     converged = converged or stats.coverage >= target
+    # The true cause rides Stats now (threaded by every backend), so both
+    # paths -- and the replayed fast path -- report "exhausted" whenever
+    # the wave died, even in the window the round cap was hit.
     reason = ("exhausted: no messages in flight"
-              if getattr(stepper, "exhausted", False) else "max rounds")
+              if stats.exhausted else "max rounds")
     printer.done(coverage_ms, stats, target_pct=target * 100.0,
                  converged=converged, reason=reason)
-    if own_printer:
-        printer.close()
-    return RunResult(
+    result = RunResult(
         stats=stats,
         stabilize_ms=stabilize_ms,
         coverage_ms=coverage_ms,
@@ -201,6 +242,30 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         overlay_windows=overlay_windows,
         gossip_windows=gossip_windows,
     )
+    # Terminal machine-consumable record: full RunResult + wall breakdown
+    # (JSONL-only; consumers stop scraping the `totals` stdout line).
+    payload = {
+        "converged": converged,
+        "stabilize_ms": stabilize_ms, "coverage_ms": coverage_ms,
+        "overlay_windows": overlay_windows,
+        "gossip_windows": gossip_windows,
+        "reason": None if converged else reason,
+        **stats.to_dict(),
+    }
+    if telem is not None:
+        payload["phases_s"] = {k: round(v, 6)
+                               for k, v in sorted(telem.phases.items())}
+    printer.result(payload)
+    if telem is not None:
+        report = _telemetry.TelemetryReport(
+            n=cfg.n, phases=telem.phases,
+            overlay=telem.overlay_snapshot(),
+            gossip=telem.gossip_snapshot(),
+            overlay_clock_scale=getattr(stepper, "overlay_clock_scale", 1.0))
+        printer.telemetry(report.summary())
+        if cfg.telemetry_summary:
+            printer.block(report.summary_block())
+    return result
 
 
 class _Checkpointer:

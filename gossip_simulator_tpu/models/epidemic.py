@@ -514,11 +514,17 @@ def run_call_budget(cfg: Config) -> int:
     return max(64, min(cfg.max_rounds, 1024, int(3.3e9 // max(cfg.n, 1))))
 
 
-def make_run_to_coverage_fn(cfg: Config):
+def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     """Device-side while_loop toward the coverage target: zero host syncs in
     the hot loop (the reference's 10 ms polling becomes one device-side
     predicate, simulator.go:243-251).  Runs until target/max_rounds/`until`
-    ticks; callers loop over bounded calls (run_call_budget)."""
+    ticks; callers loop over bounded calls (run_call_budget).
+
+    With `telemetry` the loop additionally carries a device-resident
+    per-window History (utils/telemetry.py) and records one counters row
+    after every poll window -- the trajectory the windowed driver loop
+    observes, without its per-window host round-trip; the signature becomes
+    `run_fn(st, key, target, until, hist) -> (st, hist)`."""
     step = make_step_fn(cfg)
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
@@ -526,26 +532,51 @@ def make_run_to_coverage_fn(cfg: Config):
     # occupancy to test, and the wave never "dies in flight".
     check_in_flight = cfg.protocol != "pushpull"
 
+    def cond_live(s: SimState, target_count, until):
+        live = ((s.total_received < target_count)
+                & (s.tick < max_steps) & (s.tick < until))
+        if check_in_flight:
+            # In-flight term (an O(d*n) emptiness test per window, not
+            # per tick): exit the device loop the moment the wave dies
+            # instead of spinning empty windows until the bounded-call
+            # budget lets the host notice -- parity with the event
+            # engine's cond (event.make_run_to_coverage_fn).
+            live = live & (in_flight(s) > 0)
+        return live
+
+    def run_window(s: SimState, base_key):
+        # One window per iteration keeps the predicate check off the
+        # per-tick critical path.
+        return jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), s)
+
+    if telemetry:
+        from gossip_simulator_tpu.utils import telemetry as telem
+
+        sir = cfg.protocol == "sir"
+
+        @functools.partial(jax.jit, donate_argnums=(0, 4))
+        def run_fn_t(st: SimState, base_key: jax.Array,
+                     target_count: jax.Array, until: jax.Array,
+                     hist: telem.History):
+            def cond(carry):
+                s, _ = carry
+                return cond_live(s, target_count, until)
+
+            def body(carry):
+                s, h = carry
+                s = run_window(s, base_key)
+                return s, telem.record(h, telem.gossip_probe(s, sir))
+
+            return jax.lax.while_loop(cond, body, (st, hist))
+
+        return run_fn_t
+
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run_fn(st: SimState, base_key: jax.Array, target_count: jax.Array,
                until: jax.Array) -> SimState:
         def cond(s: SimState):
-            live = ((s.total_received < target_count)
-                    & (s.tick < max_steps) & (s.tick < until))
-            if check_in_flight:
-                # In-flight term (an O(d*n) emptiness test per window, not
-                # per tick): exit the device loop the moment the wave dies
-                # instead of spinning empty windows until the bounded-call
-                # budget lets the host notice -- parity with the event
-                # engine's cond (event.make_run_to_coverage_fn).
-                live = live & (in_flight(s) > 0)
-            return live
+            return cond_live(s, target_count, until)
 
-        def body(s: SimState):
-            # One window per iteration keeps the predicate check off the
-            # per-tick critical path.
-            return jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), s)
-
-        return jax.lax.while_loop(cond, body, st)
+        return jax.lax.while_loop(cond, lambda s: run_window(s, base_key), st)
 
     return run_fn

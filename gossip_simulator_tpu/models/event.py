@@ -946,30 +946,57 @@ def poll_window_steps(cfg: Config) -> int:
     return max(1, -(-10 // batch_ticks(cfg)))
 
 
-def make_run_to_coverage_fn(cfg: Config):
+def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     """Bounded device-side while_loop, same contract as the ring engine's
-    (epidemic.make_run_to_coverage_fn / base.run_bounded_to_target)."""
+    (epidemic.make_run_to_coverage_fn / base.run_bounded_to_target).  With
+    `telemetry`, carries the device-resident per-window History and records
+    one counters row per poll window (signature gains a `hist` argument and
+    the return becomes `(st, hist)`)."""
     step = make_window_step_fn(cfg)
     max_steps = cfg.max_rounds
     steps = poll_window_steps(cfg)
+
+    def cond_live(s: EventState, target_count, until):
+        # The in-flight term (a dw-element emptiness test -- free) stops
+        # the loop the moment the wave dies instead of spinning empty
+        # windows to max_rounds (the host-side exhaustion check only
+        # runs between bounded calls).
+        return ((s.total_received < target_count)
+                & (s.tick < max_steps) & (s.tick < until)
+                & (in_flight(s) > 0))
+
+    def run_window(s: EventState, base_key):
+        return jax.lax.fori_loop(0, steps, lambda _, x: step(x, base_key), s)
+
+    if telemetry:
+        from gossip_simulator_tpu.utils import telemetry as telem
+
+        sir = cfg.protocol == "sir"
+
+        @functools.partial(jax.jit, donate_argnums=(0, 4))
+        def run_fn_t(st: EventState, base_key: jax.Array,
+                     target_count: jax.Array, until: jax.Array,
+                     hist: "telem.History"):
+            def cond(carry):
+                s, _ = carry
+                return cond_live(s, target_count, until)
+
+            def body(carry):
+                s, h = carry
+                s = run_window(s, base_key)
+                return s, telem.record(h, telem.gossip_probe(s, sir))
+
+            return jax.lax.while_loop(cond, body, (st, hist))
+
+        return run_fn_t
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run_fn(st: EventState, base_key: jax.Array, target_count: jax.Array,
                until: jax.Array) -> EventState:
         def cond(s: EventState):
-            # The in-flight term (a dw-element emptiness test -- free) stops
-            # the loop the moment the wave dies instead of spinning empty
-            # windows to max_rounds (the host-side exhaustion check only
-            # runs between bounded calls).
-            return ((s.total_received < target_count)
-                    & (s.tick < max_steps) & (s.tick < until)
-                    & (in_flight(s) > 0))
+            return cond_live(s, target_count, until)
 
-        def body(s: EventState):
-            return jax.lax.fori_loop(
-                0, steps, lambda _, x: step(x, base_key), s)
-
-        return jax.lax.while_loop(cond, body, st)
+        return jax.lax.while_loop(cond, lambda s: run_window(s, base_key), st)
 
     return run_fn
 

@@ -622,7 +622,7 @@ def run_call_budget(cfg: Config, shards: int = 1) -> int:
     return max(1, min(1024, int(4e7 * shards // max(cfg.n, 1))))
 
 
-def make_bounded_run(round_fn, quiesced_fn):
+def make_bounded_run(round_fn, quiesced_fn, telemetry: bool = False):
     """Bounded phase-1 device loop: up to `max_polls` windows per call,
     early exit at quiescence, returning (st, polls_run, quiesced) -- the
     flag rides the loop carry so callers need no eager host-side
@@ -634,8 +634,35 @@ def make_bounded_run(round_fn, quiesced_fn):
     are psum-replicated on the outer state, so the condition is
     mesh-uniform).  Trajectory-identical to the windowed host loop:
     round keys are state-indexed (st.round / st.tick), not
-    call-indexed."""
+    call-indexed.
+
+    With `telemetry` the loop carries a device-resident per-window History
+    (utils/telemetry.py), recording (clock, win_makeups, win_breakups,
+    dropped) after every poll -- one probe works for every overlay engine
+    because the sharded poll psum-replicates its window counters.  The
+    signature gains a trailing `hist` argument and the return becomes
+    (st, polls, quiesced, hist)."""
     import functools
+
+    if telemetry:
+        from gossip_simulator_tpu.utils import telemetry as telem
+
+        @functools.partial(jax.jit, donate_argnums=(0, 3))
+        def run_fn_t(st, base_key, max_polls, hist):
+            def body(carry):
+                st, polls, _, h = carry
+                st = round_fn(st, base_key)
+                h = telem.record(h, telem.overlay_probe(st))
+                return st, polls + 1, quiesced_fn(st), h
+
+            def cond(carry):
+                st, polls, q, _ = carry
+                return (polls < max_polls) & ~q
+
+            return jax.lax.while_loop(
+                cond, body, (st, jnp.zeros((), I32), quiesced_fn(st), hist))
+
+        return run_fn_t
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run_fn(st, base_key, max_polls):
@@ -654,6 +681,6 @@ def make_bounded_run(round_fn, quiesced_fn):
     return run_fn
 
 
-def make_run_fn(cfg: Config):
+def make_run_fn(cfg: Config, telemetry: bool = False):
     """Bounded device-side run for the rounds engine (make_bounded_run)."""
-    return make_bounded_run(make_round_fn(cfg), quiesced)
+    return make_bounded_run(make_round_fn(cfg), quiesced, telemetry=telemetry)

@@ -578,7 +578,7 @@ def run_call_budget(cfg: Config, shards: int = 1) -> int:
     return max(1, min(1024, int(2e7 * shards // max(cfg.n, 1))))
 
 
-def make_run_fn(cfg: Config):
+def make_run_fn(cfg: Config, telemetry: bool = False):
     """Up to `max_polls` poll windows per device call, stopping early at
     quiescence -- the phase-1 analog of the epidemic's bounded
     run-to-coverage while_loop.  The windowed host loop pays one jit
@@ -591,4 +591,5 @@ def make_run_fn(cfg: Config):
     same post-window states."""
     from gossip_simulator_tpu.models.overlay import make_bounded_run
 
-    return make_bounded_run(_make_poll_body(cfg), quiesced)
+    return make_bounded_run(_make_poll_body(cfg), quiesced,
+                            telemetry=telemetry)

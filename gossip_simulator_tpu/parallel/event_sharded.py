@@ -64,8 +64,9 @@ def event_state_specs() -> EventState:
 
 
 def _shard_map(mesh, fn, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    from gossip_simulator_tpu.parallel.mesh import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 def make_sharded_event_init(cfg: Config, mesh):
@@ -439,8 +440,10 @@ def make_seed_fn(cfg: Config, mesh):
                               in_specs=(specs, P()), out_specs=specs))
 
 
-def make_run_to_coverage_fn(cfg: Config, mesh):
-    """Bounded device-side while_loop (base.run_bounded_to_target)."""
+def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
+    """Bounded device-side while_loop (base.run_bounded_to_target).  With
+    `telemetry`, carries the per-window History inside shard_map with
+    replicated specs (see sharded_step.make_run_to_coverage_fn)."""
     step = make_sharded_event_step(cfg, mesh)
     specs = event_state_specs()
     max_steps = cfg.max_rounds
@@ -448,28 +451,61 @@ def make_run_to_coverage_fn(cfg: Config, mesh):
     # windowed driver path observes at (see event.poll_window_steps).
     steps = event.poll_window_steps(cfg)
 
+    def cond_live(s, target_count, until):
+        # The in-flight term (psum of each shard's ring-occupied
+        # indicator -- replicated, so every shard agrees) stops the
+        # loop the moment the wave dies instead of spinning empty
+        # windows until the host-side bounded-call check notices,
+        # matching the single-device cond
+        # (event.make_run_to_coverage_fn).  Indicator, not count:
+        # a cross-shard sum of entry counts could wrap int32 near
+        # ring occupancy.
+        return ((s.total_received < target_count)
+                & (s.tick < max_steps) & (s.tick < until)
+                & (jax.lax.psum(event.in_flight(s), AXIS) > 0))
+
+    if telemetry:
+        from gossip_simulator_tpu.utils import telemetry as telem
+
+        sir = cfg.protocol == "sir"
+        hspecs = telem.History(idx=P(), cols=P(None, None))
+
+        @functools.partial(jax.jit, donate_argnums=(0, 4))
+        def run_t(st: EventState, base_key, target_count, until, hist):
+            def run_shard(st, base_key, target_count, until, hist):
+                def cond(carry):
+                    s, _ = carry
+                    return cond_live(s, target_count, until)
+
+                def body(carry):
+                    s, h = carry
+                    s = jax.lax.fori_loop(
+                        0, steps, lambda _, x: step(x, base_key), s)
+                    row = telem.gossip_probe(
+                        s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
+                        pmax=lambda x: jax.lax.pmax(x, AXIS))
+                    return s, telem.record(h, row)
+
+                return jax.lax.while_loop(cond, body, (st, hist))
+
+            return _shard_map(
+                mesh, run_shard,
+                in_specs=(specs, P(), P(), P(), hspecs),
+                out_specs=(specs, hspecs))(st, base_key, target_count,
+                                           until, hist)
+
+        return run_t
+
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run(st: EventState, base_key: jax.Array, target_count: jax.Array,
             until: jax.Array) -> EventState:
         def run_shard(st, base_key, target_count, until):
-            def cond(s):
-                # The in-flight term (psum of each shard's ring-occupied
-                # indicator -- replicated, so every shard agrees) stops the
-                # loop the moment the wave dies instead of spinning empty
-                # windows until the host-side bounded-call check notices,
-                # matching the single-device cond
-                # (event.make_run_to_coverage_fn).  Indicator, not count:
-                # a cross-shard sum of entry counts could wrap int32 near
-                # ring occupancy.
-                return ((s.total_received < target_count)
-                        & (s.tick < max_steps) & (s.tick < until)
-                        & (jax.lax.psum(event.in_flight(s), AXIS) > 0))
-
             def body(s):
                 return jax.lax.fori_loop(
                     0, steps, lambda _, x: step(x, base_key), s)
 
-            return jax.lax.while_loop(cond, body, st)
+            return jax.lax.while_loop(
+                lambda s: cond_live(s, target_count, until), body, st)
 
         return _shard_map(mesh, run_shard, in_specs=(specs, P(), P(), P()),
                           out_specs=specs)(st, base_key, target_count, until)

@@ -16,6 +16,21 @@ from jax.sharding import Mesh
 AXIS = "nodes"
 
 
+def shard_map(fn, mesh, in_specs, out_specs):
+    """Version-portable shard_map: `jax.shard_map(..., check_vma=...)` on
+    current jax; `jax.experimental.shard_map.shard_map(..., check_rep=...)`
+    on the 0.4.x line.  Replication checking stays off either way (the
+    per-shard bodies return psum-replicated scalars the checker cannot
+    prove).  THE one entry point for every shard_map in the repo."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def node_mesh(n_devices: int | None = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:

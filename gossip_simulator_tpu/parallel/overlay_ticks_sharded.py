@@ -43,8 +43,9 @@ def overlay_tick_state_specs() -> ot.OverlayTickState:
 
 
 def _shard_map(mesh, fn, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    from gossip_simulator_tpu.parallel.mesh import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 def _route_append(cfg, n_local, s, ring, dst_g, pay, wslot, valid, rcap):

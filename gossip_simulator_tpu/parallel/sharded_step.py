@@ -60,8 +60,9 @@ def overlay_state_specs() -> OverlayState:
 
 
 def _shard_map(mesh, fn, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    from gossip_simulator_tpu.parallel.mesh import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 # --------------------------------------------------------------------------
@@ -382,37 +383,74 @@ def make_overlay_round_fn(cfg: Config, mesh):
                               in_specs=(specs, P()), out_specs=specs))
 
 
-def make_run_to_coverage_fn(cfg: Config, mesh):
+def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
     """Bounded device-side while_loop (see epidemic.run_call_budget): the
-    host re-enters until target/max_rounds/exhaustion."""
+    host re-enters until target/max_rounds/exhaustion.  With `telemetry`
+    the loop carries the per-window History (utils/telemetry.py) inside
+    shard_map with replicated specs -- the recorded totals are already
+    psum-replicated by the step; the per-shard occupancy/removed probes
+    reduce across shards so every shard writes identical rows."""
     step = make_sharded_step(cfg, mesh)
     specs = sim_state_specs()
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
     check_in_flight = cfg.protocol != "pushpull"
 
+    def cond_live(s, target_count, until):
+        live = ((s.total_received < target_count)
+                & (s.tick < max_steps) & (s.tick < until))
+        if check_in_flight:
+            # psum of each shard's ring-occupied indicator
+            # (replicated, so every shard agrees): exit at wave
+            # death instead of spinning to the bounded-call budget
+            # -- same term the sharded event engine's cond has
+            # (event_sharded.make_run_to_coverage_fn).
+            live = live & (jax.lax.psum(state_mod.in_flight(s),
+                                        AXIS) > 0)
+        return live
+
+    if telemetry:
+        from gossip_simulator_tpu.utils import telemetry as telem
+
+        sir = cfg.protocol == "sir"
+        hspecs = telem.History(idx=P(), cols=P(None, None))
+
+        @functools.partial(jax.jit, donate_argnums=(0, 4))
+        def run_t(st: SimState, base_key, target_count, until, hist):
+            def run_shard(st, base_key, target_count, until, hist):
+                def cond(carry):
+                    s, _ = carry
+                    return cond_live(s, target_count, until)
+
+                def body(carry):
+                    s, h = carry
+                    s = jax.lax.fori_loop(
+                        0, window, lambda _, x: step(x, base_key), s)
+                    row = telem.gossip_probe(
+                        s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
+                        pmax=lambda x: jax.lax.pmax(x, AXIS))
+                    return s, telem.record(h, row)
+
+                return jax.lax.while_loop(cond, body, (st, hist))
+
+            return _shard_map(
+                mesh, run_shard,
+                in_specs=(specs, P(), P(), P(), hspecs),
+                out_specs=(specs, hspecs))(st, base_key, target_count,
+                                           until, hist)
+
+        return run_t
+
     @functools.partial(jax.jit, donate_argnums=(0,))
     def run(st: SimState, base_key: jax.Array, target_count: jax.Array,
             until: jax.Array) -> SimState:
         def run_shard(st, base_key, target_count, until):
-            def cond(s):
-                live = ((s.total_received < target_count)
-                        & (s.tick < max_steps) & (s.tick < until))
-                if check_in_flight:
-                    # psum of each shard's ring-occupied indicator
-                    # (replicated, so every shard agrees): exit at wave
-                    # death instead of spinning to the bounded-call budget
-                    # -- same term the sharded event engine's cond has
-                    # (event_sharded.make_run_to_coverage_fn).
-                    live = live & (jax.lax.psum(state_mod.in_flight(s),
-                                                AXIS) > 0)
-                return live
-
             def body(s):
                 return jax.lax.fori_loop(
                     0, window, lambda _, x: step(x, base_key), s)
 
-            return jax.lax.while_loop(cond, body, st)
+            return jax.lax.while_loop(
+                lambda s: cond_live(s, target_count, until), body, st)
 
         return _shard_map(mesh, run_shard, in_specs=(specs, P(), P(), P()),
                           out_specs=specs)(st, base_key, target_count, until)

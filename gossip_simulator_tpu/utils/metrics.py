@@ -22,6 +22,13 @@ import sys
 import time
 from typing import Optional
 
+# JSONL record-format version, stamped on every record so logs are
+# machine-consumable without sniffing.  v2 added `schema_version` itself,
+# the terminal `event="result"` record (full RunResult + wall-time
+# breakdown), `Stats.exhausted`, and the fast-path `event="telemetry"`
+# report (utils/telemetry.py).
+SCHEMA_VERSION = 2
+
 
 @dataclasses.dataclass
 class Stats:
@@ -37,6 +44,10 @@ class Stats:
     breakups: int = 0  # (BreakUps)
     mailbox_dropped: int = 0  # framework-only: capacity-overflow drops
     exchange_overflow: int = 0  # framework-only: all_to_all bucket overflow
+    # True when the run ended with no messages in flight (the wave died) --
+    # threaded here so printer.done() reports the true nonconvergence cause
+    # on both the windowed and the fast path (reason parity).
+    exhausted: bool = False
 
     @property
     def coverage(self) -> float:
@@ -82,7 +93,12 @@ class ProgressPrinter:
     def _emit(self, line: str, progress_only: bool = False, **record):
         if not self.silent and (self.enabled or not progress_only):
             print(line, file=self.out, flush=True)
+        self._record(**record)
+
+    def _record(self, **record):
+        """JSONL-only record (no stdout line)."""
         if self._jsonl:
+            record["schema_version"] = SCHEMA_VERSION
             record["wall_s"] = time.perf_counter() - self._t0
             self._jsonl.write(json.dumps(record) + "\n")
             self._jsonl.flush()
@@ -133,7 +149,32 @@ class ProgressPrinter:
     def section(self, title: str):
         self._emit(f"\n=== {title} ===", event="section", title=title)
 
+    def result(self, payload: dict):
+        """Terminal machine-consumable record: the full RunResult plus the
+        wall-time breakdown, JSONL-only -- downstream consumers no longer
+        scrape the `totals` stdout line."""
+        self._record(event="result", **payload)
+
+    def telemetry(self, summary: dict):
+        """Fast-path telemetry report (utils/telemetry.py): phase ledger,
+        throughput and per-window trajectory.  JSONL-only."""
+        self._record(event="telemetry", **summary)
+
+    def block(self, text: str):
+        """Multi-line end-of-run stdout block (e.g. -telemetry-summary);
+        never enters the JSONL stream."""
+        if not self.silent:
+            print(text, file=self.out, flush=True)
+
     def close(self):
         if self._jsonl:
             self._jsonl.close()
             self._jsonl = None
+
+    def __enter__(self) -> "ProgressPrinter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # Close on ANY exit so the JSONL file is flushed even when the run
+        # raises (cli.py / bench.py wrap runs in `with`).
+        self.close()

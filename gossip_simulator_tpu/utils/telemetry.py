@@ -1,0 +1,333 @@
+"""Device-resident telemetry: per-window history without leaving the fast path.
+
+The reference's only observability is six polled atomics printed every 10 ms
+(simulator.go:26-31); our windowed driver loop reproduces that surface but
+pays one jit dispatch + one device->host stats round-trip per 10 simulated ms
+(~2x wall-clock at n=1e7 through the TPU tunnel).  This module removes the
+observability-vs-speed tradeoff: the bounded device-side while_loops
+(epidemic/event `make_run_to_coverage_fn`, overlay `make_bounded_run`) write
+one row of counters per poll window into a preallocated device `History`
+buffer -- a handful of scalar ops against a window of O(n) work -- and the
+host fetches the whole trajectory in ONE transfer at loop exit.
+
+`replay_overlay` / `replay_gossip` then drive the fetched history through the
+ordinary ProgressPrinter, producing stdout/JSONL per-window output
+byte-identical to the windowed loop's (the golden CLI transcripts enforce
+this), so a progress-printing or JSONL-logging run takes the fast path
+whenever checkpointing is off.  `TelemetrySession` is the host-side holder a
+backend carries: device histories for both phases plus the wall-clock phase
+ledger (init / compile / execute / fetch); `TelemetryReport` turns it into
+throughput numbers, per-window deltas and the `-telemetry-summary` block.
+
+History rows are int32; the 64-bit total_message pair travels as two
+bitcast int32 columns and is reassembled host-side (msg64 convention from
+models/state.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+# Column layouts (one int32 matrix per phase keeps the per-window write a
+# single row scatter instead of one per counter).
+GOSSIP_COLS = ("tick", "received", "msg_hi", "msg_lo", "crashed", "removed",
+               "mail_high", "dropped", "overflow")
+OVERLAY_COLS = ("clock", "makeups", "breakups", "dropped")
+
+
+class History(NamedTuple):
+    """Device-resident per-window ring: `idx` rows written (keeps counting
+    past the capacity so truncation is detectable; writes saturate at the
+    last row), `cols` the int32[cap, F] matrix."""
+
+    idx: object  # int32[]
+    cols: object  # int32[cap, F]
+
+
+def empty_history(cap: int, ncols: int) -> History:
+    import jax.numpy as jnp
+
+    return History(idx=jnp.zeros((), jnp.int32),
+                   cols=jnp.zeros((max(int(cap), 1), ncols), jnp.int32))
+
+
+def record(hist: History, row) -> History:
+    """Append one window's row (list of int32 scalars) device-side."""
+    import jax.numpy as jnp
+
+    cap = hist.cols.shape[0]
+    vals = jnp.stack([jnp.asarray(v).astype(jnp.int32) for v in row])
+    i = jnp.minimum(hist.idx, cap - 1)
+    return History(idx=hist.idx + 1, cols=hist.cols.at[i].set(vals))
+
+
+def gossip_probe(st, sir: bool, psum=None, pmax=None):
+    """One GOSSIP_COLS row from either epidemic engine's state (duck-typed
+    like models/state.in_flight: EventState has the mail ring, SimState the
+    pending ring).  `psum`/`pmax` are the sharded engines' cross-shard
+    reductions for the per-shard quantities (removed flags, ring occupancy);
+    the totals are already psum-replicated by the step functions."""
+    import jax
+    import jax.numpy as jnp
+
+    I32 = jnp.int32
+    z = jnp.zeros((), I32)
+    if hasattr(st, "flags"):  # event engine
+        from gossip_simulator_tpu.models.event import REMOVED
+
+        removed = ((st.flags & REMOVED) > 0).sum(dtype=I32) if sir else z
+        high = st.mail_cnt.max().astype(I32)
+        dropped = st.mail_dropped
+    else:
+        removed = st.removed.sum(dtype=I32) if sir else z
+        # Per-(slot, node) arrival-count high-water -- the ring engine's
+        # analog of the fullest mailbox.
+        high = st.pending.max().astype(I32)
+        dropped = z
+    if psum is not None:
+        removed = psum(removed)
+    if pmax is not None:
+        high = pmax(high)
+    msg = jax.lax.bitcast_convert_type(st.total_message, I32)
+    return [st.tick, st.total_received, msg[0], msg[1], st.total_crashed,
+            removed, high, dropped, st.exchange_overflow]
+
+
+def overlay_probe(st):
+    """One OVERLAY_COLS row from either overlay engine's state (the
+    tick-faithful engine carries `tick`, the rounds engine `round`; the
+    window counters are already global/replicated on both)."""
+    clock = st.tick if hasattr(st, "tick") else st.round
+    return [clock, st.win_makeups, st.win_breakups, st.mailbox_dropped]
+
+
+def gossip_history_cap(cfg) -> int:
+    """Phase-2 window capacity: every engine's poll window advances at least
+    WINDOW_MS ticks in ticks mode (event.poll_window_steps rounds UP) and
+    one round in rounds mode, so ceil(max_rounds / window) bounds the rows."""
+    window = 1 if cfg.effective_time_mode == "rounds" else 10
+    return max(1, -(-cfg.max_rounds // window) + 2)
+
+
+def fetch_history(hist: Optional[History]) -> Optional[dict]:
+    """ONE device->host transfer of a whole history buffer."""
+    if hist is None:
+        return None
+    import jax
+
+    idx, cols = jax.device_get((hist.idx, hist.cols))
+    recorded = int(idx)
+    cols = np.asarray(cols)
+    return {"count": min(recorded, cols.shape[0]), "recorded": recorded,
+            "truncated": recorded > cols.shape[0], "cols": cols}
+
+
+def host_history(rows: list) -> Optional[dict]:
+    """Same shape as fetch_history for host-side recorded rows (the split
+    overlay round's host loop)."""
+    if not rows:
+        return None
+    cols = np.asarray(rows, np.int32).reshape(len(rows), -1)
+    return {"count": len(rows), "recorded": len(rows), "truncated": False,
+            "cols": cols}
+
+
+# --- replay -----------------------------------------------------------------
+
+def replay_overlay(printer, hist: Optional[dict], clock_scale: float,
+                   quiesced: bool = True) -> None:
+    """Re-emit the phase-1 per-window lines exactly as the windowed loop
+    would have: the quiescing window itself is never printed
+    (simulator.go:227-230 prints only when *not* stabilizing)."""
+    if not hist:
+        return
+    cols, count = hist["cols"], hist["count"]
+    last = count - 1 if quiesced else count
+    for i in range(max(0, last)):
+        # clock_scale 1.0 (faithful ticks) reproduces float(tick) exactly;
+        # the rounds engine's round * mean_delay is the windowed loop's
+        # identical float expression.
+        printer.overlay_window(int(cols[i, 2]), int(cols[i, 1]),
+                               float(cols[i, 0]) * clock_scale)
+
+
+def replay_gossip(printer, hist: Optional[dict], n: int) -> None:
+    """Re-emit the phase-2 coverage lines: same float math as the windowed
+    driver loop (coverage = int received / int n, pct rounded to 4)."""
+    if not hist:
+        return
+    cols = hist["cols"]
+    for i in range(hist["count"]):
+        pct = (int(cols[i, 1]) / n if n else 0.0) * 100.0
+        printer.coverage_window(round(pct, 4), float(cols[i, 0]))
+
+
+def _msg64_col(cols: np.ndarray) -> np.ndarray:
+    """Reassemble the bitcast [hi, lo] int32 column pair into uint64."""
+    pair = cols[:, 2:4].astype(np.int32).view(np.uint32).astype(np.uint64)
+    return (pair[:, 0] << np.uint64(32)) | pair[:, 1]
+
+
+# --- host-side session ------------------------------------------------------
+
+class TelemetrySession:
+    """Per-stepper holder: device histories for both phases plus the
+    wall-clock phase ledger.  The first-ever bounded device call of each
+    phase is tallied as `compile_s` (tracing + XLA compile dominate it;
+    subsequent calls reuse the executable), the rest as `execute_s`."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.phases: dict[str, float] = {}
+        self._gossip: Optional[History] = None
+        self._overlay: Optional[History] = None
+        self._overlay_host_rows: list = []
+        self._gossip_calls = 0
+        self._overlay_calls = 0
+        self._gossip_fetched: Optional[dict] = None
+        self._overlay_fetched: Optional[dict] = None
+
+    # --- phase ledger ---------------------------------------------------
+    def add_phase(self, key: str, seconds: float) -> None:
+        self.phases[key] = self.phases.get(key, 0.0) + seconds
+
+    def tally_gossip_call(self, seconds: float) -> None:
+        self.add_phase("compile_s" if self._gossip_calls == 0 else
+                       "execute_s", seconds)
+        self._gossip_calls += 1
+
+    def tally_overlay_call(self, seconds: float) -> None:
+        self.add_phase("compile_s" if self._overlay_calls == 0 else
+                       "execute_s", seconds)
+        self._overlay_calls += 1
+
+    # --- phase-2 history ------------------------------------------------
+    def begin_gossip(self) -> History:
+        if self._gossip is None:
+            self._gossip = empty_history(gossip_history_cap(self.cfg),
+                                         len(GOSSIP_COLS))
+        return self._gossip
+
+    def end_gossip(self, hist: History) -> None:
+        self._gossip = hist
+
+    def reset_gossip(self) -> None:
+        """Drop phase-2 history (a reset_state rerun records afresh)."""
+        self._gossip = None
+        self._gossip_fetched = None
+
+    def gossip_snapshot(self) -> Optional[dict]:
+        if self._gossip_fetched is None and self._gossip is not None:
+            import time
+
+            t0 = time.perf_counter()
+            self._gossip_fetched = fetch_history(self._gossip)
+            self.add_phase("fetch_s", time.perf_counter() - t0)
+        return self._gossip_fetched
+
+    # --- phase-1 history ------------------------------------------------
+    def begin_overlay(self, cap: int) -> History:
+        if self._overlay is None:
+            self._overlay = empty_history(cap, len(OVERLAY_COLS))
+        return self._overlay
+
+    def end_overlay(self, hist: History) -> None:
+        self._overlay = hist
+
+    def overlay_host_row(self, row) -> None:
+        """Host-side recording for the split-round overlay (its round is a
+        host-driven call sequence; the per-round device_get it already pays
+        carries the counters)."""
+        self._overlay_host_rows.append([int(v) for v in row])
+
+    def overlay_snapshot(self) -> Optional[dict]:
+        if self._overlay_fetched is None:
+            if self._overlay is not None:
+                import time
+
+                t0 = time.perf_counter()
+                self._overlay_fetched = fetch_history(self._overlay)
+                self.add_phase("fetch_s", time.perf_counter() - t0)
+            elif self._overlay_host_rows:
+                self._overlay_fetched = host_history(self._overlay_host_rows)
+        return self._overlay_fetched
+
+
+# --- report -----------------------------------------------------------------
+
+@dataclasses.dataclass
+class TelemetryReport:
+    """Host-side view of one run's telemetry: phase ledger, throughput and
+    the per-window trajectory (what the reference never had)."""
+
+    n: int
+    phases: dict
+    overlay: Optional[dict] = None
+    gossip: Optional[dict] = None
+    overlay_clock_scale: float = 1.0
+
+    def summary(self) -> dict:
+        out: dict = {"phases_s": {k: round(v, 6)
+                                  for k, v in sorted(self.phases.items())}}
+        execute = self.phases.get("execute_s", 0.0) \
+            + self.phases.get("compile_s", 0.0)
+        if self.overlay:
+            out["overlay_windows"] = self.overlay["count"]
+            if self.overlay["truncated"]:
+                out["overlay_truncated"] = True
+        if self.gossip:
+            cols, count = self.gossip["cols"], self.gossip["count"]
+            out["gossip_windows"] = count
+            if self.gossip["truncated"]:
+                out["gossip_truncated"] = True
+            if count:
+                ticks = int(cols[count - 1, 0])
+                msg = _msg64_col(cols[:count])
+                out["sim_ticks"] = ticks
+                out["total_message"] = int(msg[-1])
+                if execute > 0:
+                    out["node_updates_per_sec"] = round(
+                        self.n * ticks / execute, 1)
+                    out["messages_per_sec"] = round(int(msg[-1]) / execute, 1)
+                per = {
+                    "tick": cols[:count, 0].tolist(),
+                    "received": cols[:count, 1].tolist(),
+                    "message": [int(v) for v in msg],
+                    "crashed": cols[:count, 4].tolist(),
+                    "removed": cols[:count, 5].tolist(),
+                    "mail_high": cols[:count, 6].tolist(),
+                    "dropped": cols[:count, 7].tolist(),
+                    "overflow": cols[:count, 8].tolist(),
+                }
+                out["per_window"] = per
+                out["deltas"] = {
+                    "received": np.diff(cols[:count, 1],
+                                        prepend=0).tolist(),
+                    "message": np.diff(msg.astype(np.int64),
+                                       prepend=np.int64(0)).tolist(),
+                }
+        return out
+
+    def summary_block(self) -> str:
+        """The `-telemetry-summary` end-of-run stdout block."""
+        s = self.summary()
+        ph = s.get("phases_s", {})
+        lines = ["\n=== Telemetry ==="]
+        lines.append("phases: " + " ".join(
+            f"{k[:-2]} {v:.3f}s" for k, v in ph.items()) if ph
+            else "phases: (none recorded)")
+        if "overlay_windows" in s:
+            lines.append(f"overlay: {s['overlay_windows']} windows")
+        if "gossip_windows" in s:
+            g = f"gossip: {s['gossip_windows']} windows"
+            if "sim_ticks" in s:
+                g += f", {s['sim_ticks']} simulated ms"
+            lines.append(g)
+        if "node_updates_per_sec" in s:
+            lines.append(f"throughput: {s['node_updates_per_sec']:g} "
+                         f"node-updates/s, {s['messages_per_sec']:g} "
+                         "messages/s")
+        return "\n".join(lines)

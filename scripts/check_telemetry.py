@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Telemetry smoke check (CI tier-1 companion; see .github/workflows/).
+
+Runs the CLI twice on the same seeded config -- telemetry on (device-side
+fast path + replay) and `-telemetry off` (windowed host loop) -- and
+verifies the tentpole contract end to end:
+
+  * stdout is byte-identical,
+  * the JSONL streams match event-for-event (modulo wall clocks),
+  * the fast run carries the `result` and `telemetry` records,
+  * exit codes agree.
+
+Exits nonzero on any mismatch.  Runs on CPU in ~a minute.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ARGS = ["-n", "1200", "-backend", "jax", "-graph", "overlay",
+        "-overlay-mode", "ticks", "-fanout", "5", "-seed", "9",
+        "-coverage-target", "0.9"]
+
+
+def _run(jsonl: str, *extra: str) -> tuple[int, str]:
+    env = dict(os.environ)
+    # Force the CPU platform the way tests/conftest.py does: the smoke
+    # check must not depend on an accelerator being attached.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    proc = subprocess.run(
+        [sys.executable, "-m", "gossip_simulator_tpu", *ARGS,
+         "-log-jsonl", jsonl, *extra],
+        cwd=REPO, env=env, text=True, capture_output=True, timeout=600)
+    return proc.returncode, proc.stdout
+
+
+def _records(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _strip(rec: dict) -> dict:
+    return {k: v for k, v in rec.items() if k not in ("wall_s", "phases_s")}
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as td:
+        fast_log = os.path.join(td, "fast.jsonl")
+        win_log = os.path.join(td, "win.jsonl")
+        rc_f, out_f = _run(fast_log)
+        rc_w, out_w = _run(win_log, "-telemetry", "off")
+        ok = True
+        if rc_f != rc_w:
+            print(f"FAIL: exit codes differ ({rc_f} vs {rc_w})")
+            ok = False
+        if out_f != out_w:
+            print("FAIL: stdout differs between fast-path replay and the "
+                  "windowed loop")
+            for a, b in zip(out_f.splitlines(), out_w.splitlines()):
+                if a != b:
+                    print(f"  fast: {a!r}\n  wind: {b!r}")
+                    break
+            ok = False
+        fast = _records(fast_log)
+        win = _records(win_log)
+        shared = [_strip(r) for r in fast if r["event"] != "telemetry"]
+        if shared != [_strip(r) for r in win]:
+            print("FAIL: JSONL streams differ")
+            ok = False
+        events = [r["event"] for r in fast]
+        for required in ("params", "overlay", "coverage", "done", "totals",
+                         "result", "telemetry"):
+            if required not in events:
+                print(f"FAIL: fast JSONL missing event={required!r}")
+                ok = False
+        if ok:
+            t = [r for r in fast if r["event"] == "telemetry"][0]
+            print("OK: stdout byte-identical, "
+                  f"{len(shared)} shared JSONL records, "
+                  f"{t.get('overlay_windows', 0)} overlay + "
+                  f"{t.get('gossip_windows', 0)} gossip windows replayed, "
+                  f"phases {t.get('phases_s')}")
+        return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 """Checkpoint save/load (utils/checkpoint.py) and resume on the jax backend."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -7,6 +8,16 @@ from gossip_simulator_tpu.backends.jax_backend import JaxStepper
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.utils import checkpoint
 from gossip_simulator_tpu.utils.metrics import Stats
+
+# On the legacy shard_map line (jax < jax.shard_map, e.g. 0.4.x) the CPU
+# backend's intra-process cross_module AllReduce rendezvous deadlocks when
+# two different sharded executables are dispatched from one process (7/8
+# participants arrive, the suite hangs, not a failure) -- exactly what the
+# reshard/repack resume tests do.  They run on current jax / real meshes.
+legacy_shard_map_deadlock = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="legacy shard_map: CPU collective rendezvous deadlocks when two "
+           "sharded executables interleave in one process")
 
 
 def test_roundtrip(tmp_path):
@@ -127,6 +138,7 @@ def test_sharded_ring_resume_reproduces_trajectory(tmp_path):
         assert s2.gossip_window() == want
 
 
+@legacy_shard_map_deadlock
 def test_sharded_resume_repacks_mail_geometry(tmp_path):
     """A sharded snapshot written under one -event-chunk restores under a
     different one via the per-shard slot repack."""
@@ -169,6 +181,7 @@ def _decode_entries(tree, cfg, s_ckpt):
     return sorted(out)
 
 
+@legacy_shard_map_deadlock
 def test_sharded_resume_reshards_1_to_8_and_back(tmp_path):
     """VERDICT r4 #3: an S=1 snapshot restores onto an S=8 mesh (and
     back) via a host-side reshard of the per-shard mail rings.  Every
@@ -226,6 +239,7 @@ def test_sharded_resume_reshards_1_to_8_and_back(tmp_path):
     assert sj2.stats().coverage >= 0.99
 
 
+@legacy_shard_map_deadlock
 def test_driver_resume_flag_sharded(tmp_path):
     """End-to-end -resume on backend=sharded through the driver."""
     from gossip_simulator_tpu.driver import run_simulation

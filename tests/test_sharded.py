@@ -45,9 +45,11 @@ def test_route_one_roundtrip():
     payload[0] = 100 + np.arange(8)
     dest[0] = np.arange(8)
     valid[0] = True
-    fn = jax.jit(jax.shard_map(
+    from gossip_simulator_tpu.parallel.mesh import shard_map
+
+    fn = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P("nodes", None),) * 3,
-        out_specs=(P("nodes"), P("nodes")), check_vma=False))
+        out_specs=(P("nodes"), P("nodes"))))
     recv, overflow = fn(payload, dest, valid)
     recv = np.asarray(recv).reshape(8, 32)
     assert int(np.asarray(overflow).sum()) == 0
