@@ -220,18 +220,24 @@ def headline(n: int | None, seed: int) -> dict:
 
 
 def capture_sharded_1chip(detail: dict, seed: int) -> None:
-    """VERDICT r3 #1: the sharded engine's real-TPU cost at equal n vs the
-    jax backend -- measures the routing constant (route_multi sort+scatter,
-    bucket compaction, pmax-agreed batch counts; all_to_all is degenerate
-    at S=1) that the v5e-8 projection assumes.  Round-4 measurement:
-    10M fanout 3 sharded 2.394s vs jax 2.259s (+6%); 50M fanout 6 @99%
-    sharded 21.44s (86.1 ns/msg) vs jax 19.40s (75.3 ns/msg) -- +10.5%
-    wall, ~+11 ns/entry.  100M on ONE device exceeds the sharded wire
-    packing bound (n_local*dw*B < 2^31 -- a per-SHARD bound: v5e-8's
+    """VERDICT r3 #1 / r5 #1: the sharded engine's real-TPU cost at equal
+    n vs the jax backend.  Through round 5 the S=1 twin measured the full
+    routing constant (route_multi sort+scatter, post-exchange filtering;
+    61.6 vs 48.7 ns/msg at 50M fanout 6 -- the 27% gap VERDICT r5 named).
+    Round 6 ELIMINATED the identity work on a 1-device mesh (sort-free
+    bucketing, pre-exchange suppression, DIRECT_SELF_APPEND -- see
+    parallel/event_sharded.py; bit-identical totals by construction and
+    by tests/test_sharded.py's parity pins), so the S=1 twin now measures
+    the per-shard constant the v5e-8 projection's term 1 cites, while the
+    S>1-only routing machinery is measured separately by
+    scripts/profile_exchange.py (the projection's term 2).  Round-4
+    history: 10M fanout 3 sharded 2.394s vs jax 2.259s (+6%); 50M fanout
+    6 @99% 21.44s vs 19.40s.  100M on ONE device exceeds the sharded
+    wire packing bound (n_local*dw*B < 2^31 -- a per-SHARD bound: v5e-8's
     n_local=12.5M is 30x inside it), so 50M is the largest 1-chip twin.
     The rows record `devices`: on a multi-chip host the sharded rows are
-    a real S-way run (ICI included), not the S=1 routing-constant twin --
-    read them accordingly."""
+    a real S-way run (ICI included), not the S=1 twin -- read them
+    accordingly."""
     base = Config(n=10_000_000, fanout=3, graph="kout", backend="sharded",
                   seed=seed, crashrate=0.001, coverage_target=0.90,
                   max_rounds=3000, pallas=True, progress=False).validate()
@@ -252,6 +258,28 @@ def capture_sharded_1chip(detail: dict, seed: int) -> None:
             detail[name] = _bench_backend(cfg)
         except Exception as e:  # record, don't kill the record
             detail[name] = {"error": repr(e)}
+
+
+def capture_exchange_profile(detail: dict) -> None:
+    """Routing-constant micro-profile (scripts/profile_exchange.py run
+    in-process -- a subprocess would open a second TPU client while this
+    one is live): the per-component append/route constants the README
+    v5e-8 projection's term 2 cites."""
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "profile_exchange",
+            os.path.join(here, "scripts", "profile_exchange.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        m = 786_432 if jax.default_backend() == "tpu" else 98_304
+        prof = mod.profile_append_s1(m, 5)
+        prof["ns_per_lane"] = {k[:-2]: v * 1e9 / m for k, v in prof.items()}
+        detail["exchange_profile"] = {"m": m, "append_s1": prof}
+    except Exception as e:  # record, don't kill the record
+        detail["exchange_profile"] = {"error": repr(e)}
 
 
 def capture_100m_two_phase(detail: dict, seed: int) -> None:
@@ -446,6 +474,15 @@ def full_suite(seed: int) -> list[dict]:
         except Exception as e:  # record, don't kill the suite
             r = {"error": repr(e)}
         r["config"] = name
+        if name == "si_1k_fanout1":
+            # Self-describing record (VERDICT r5 #7b): the die-out is the
+            # measurement, not a failure -- nobody should re-read it as a
+            # broken row.
+            r["note"] = ("expected die-out: fanout-1 chains + 10% drop "
+                         "kill the wave after ~10 hops; converged=False "
+                         "with ~0.2% coverage IS the correct physics "
+                         "(the reference would poll forever here, "
+                         "SURVEY 5.3a)")
         r["wall_s"] = round(time.perf_counter() - t0, 3)
         out.append(r)
     # Overlay phase-1 timing rows (the reference's "Constructing Overlay"
@@ -496,6 +533,7 @@ def main() -> int:
             with open(partial, "w") as fh:
                 json.dump(result, fh)
             capture_sharded_1chip(result["detail"], args.seed)
+            capture_exchange_profile(result["detail"])
             capture_scale50(result["detail"], args.seed)
             # Refresh the salvage so a worker fault in the near-ceiling
             # 100M rows can't discard the just-measured sharded twins.

@@ -307,7 +307,13 @@ class JaxStepper(Stepper):
         done -- phase-2 snapshots take over then)."""
         if self._overlay_done or self.ostate is None:
             return None
-        return {k: np.asarray(v) for k, v in self.ostate._asdict().items()}
+        # np.array (COPY), not np.asarray: on the CPU platform asarray of
+        # a device buffer is zero-copy, and the donating round fns reuse
+        # that buffer on the next call -- the "snapshot" would silently
+        # track the live state (observed as resumed-trajectory drift in
+        # the checkpoint tests; on TPU the device->host transfer always
+        # copied, which is why hardware never showed it).
+        return {k: np.array(v) for k, v in self.ostate._asdict().items()}
 
     def load_overlay_state_pytree(self, tree, windows: int = 0) -> None:
         """Resume INTO phase 1: validate the overlay snapshot
@@ -324,7 +330,10 @@ class JaxStepper(Stepper):
         self._setup_overlay(build_state=False)
         cls = (self._omod.OverlayTickState if self._faithful_overlay
                else self._omod.OverlayState)
-        self.ostate = cls(**{k: jax.numpy.asarray(v)
+        # jax.numpy.array (device COPY), not asarray: a zero-copy restore
+        # feeding the donating round fns lets XLA reuse a buffer it does
+        # not own (see load_state_pytree's note).
+        self.ostate = cls(**{k: jax.numpy.array(v)
                              for k, v in tree.items()})
         self._overlay_rounds = int(windows)
         self._phase1_ms = (
@@ -334,7 +343,9 @@ class JaxStepper(Stepper):
     def state_pytree(self):
         if self.state is None:
             return None
-        tree = {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        # COPY (np.array), never view: see overlay_state_pytree's note on
+        # the CPU zero-copy + donated-buffer-reuse aliasing.
+        tree = {k: np.array(v) for k, v in self.state._asdict().items()}
         if "mail_ids" in tree:
             # Record the mail-ring geometry so a future build whose AUTO
             # slot-cap/chunk sizing differs can repack instead of rejecting
@@ -360,7 +371,12 @@ class JaxStepper(Stepper):
         tree = prepare_restore_tree(tree, cfg, n_shards=1)
         self._mailbox_dropped = int(tree.pop("host_mailbox_dropped", 0))
         cls = EventState if cfg.engine_resolved == "event" else SimState
-        self.state = cls(**{k: jax.numpy.asarray(v)
+        # jax.numpy.array (device COPY), not asarray: on the CPU platform
+        # asarray of a host array can be zero-copy, and these leaves feed
+        # straight into DONATING jitted fns -- XLA then reuses a buffer it
+        # does not own, corrupting the restored state (the load-side twin
+        # of state_pytree's copy note; TPU transfers always copy).
+        self.state = cls(**{k: jax.numpy.array(v)
                             for k, v in tree.items()})
         self._overlay_done = True
         self._seeded = True  # snapshots are taken mid-phase-2

@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.models import epidemic, overlay
@@ -26,9 +27,13 @@ def _host_gather(x) -> np.ndarray:
     fully addressable from one process; process_allgather (a collective --
     every process must traverse the same leaves in the same order, which
     NamedTuple._asdict guarantees) assembles the global value on every
-    host.  Replicated scalars and single-process runs take the plain path."""
+    host.  Replicated scalars and single-process runs take the plain path.
+    np.array (COPY), not np.asarray: on the CPU platform asarray of a
+    device buffer is zero-copy and the donating window fns reuse the
+    buffer on the next call, silently mutating the 'snapshot' (see
+    JaxStepper.overlay_state_pytree's note)."""
     if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)
+        return np.array(x)
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
@@ -119,7 +124,7 @@ class ShardedStepper(Stepper):
                     f"fused-round memory band (>= "
                     f"{overlay.SPLIT_ROUND_MIN_ROWS}); the sharded engine "
                     "has no split-round fallback -- use at least "
-                    f"{cfg.n // overlay.SPLIT_ROUND_MIN_ROWS + 1} devices "
+                    f"{-(-cfg.n // overlay.SPLIT_ROUND_MIN_ROWS)} devices "
                     "for this n, or expect HBM exhaustion on 16 GB chips",
                     stacklevel=2)
             self._oround = sharded_step.make_overlay_round_fn(
@@ -336,8 +341,11 @@ class ShardedStepper(Stepper):
             from gossip_simulator_tpu.models.state import OverlayState
 
             cls, specs = OverlayState, sharded_step.overlay_state_specs()
+        # jnp.array (device COPY) before placement: see load_state_pytree's
+        # zero-copy + donation note.
         self.ostate = cls(**{
-            k: jax.device_put(v, NamedSharding(mesh, getattr(specs, k)))
+            k: jax.device_put(jnp.array(v),
+                              NamedSharding(mesh, getattr(specs, k)))
             for k, v in tree.items()})
         self._overlay_rounds = int(windows)
         self._phase1_ms = (
@@ -387,8 +395,16 @@ class ShardedStepper(Stepper):
             cls, specs = EventState, event_sharded.event_state_specs()
         else:
             cls, specs = SimState, sharded_step.sim_state_specs()
+        # jnp.array (device COPY) before placement: on the CPU platform
+        # device_put of a host array can be zero-copy, and the restored
+        # leaves feed straight into DONATING jitted fns -- XLA then reuses
+        # a buffer it does not own, corrupting the restored state
+        # (observed as nondeterministic quiet-resume totals on the CPU
+        # mesh; the save-side twin of _host_gather's copy note.  TPU
+        # device_put always copies to HBM, masking this on hardware).
         self.state = cls(**{
-            k: jax.device_put(v, NamedSharding(mesh, getattr(specs, k)))
+            k: jax.device_put(jnp.array(v),
+                              NamedSharding(mesh, getattr(specs, k)))
             for k, v in tree.items()})
         self._overlay_done = True
         self._seeded = True  # snapshots are taken mid-phase-2

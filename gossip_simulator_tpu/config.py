@@ -116,12 +116,18 @@ class Config:
     # whose destination already has the received bit -- monotone, so it is
     # STILL set at delivery -- can only increment total_message there
     # (simulator.go:111,117-119); with an effective crash rate of 0 there
-    # is not even a crash draw.  Suppression counts such edges into
-    # total_message at append time and never writes them into the mail
-    # ring (~4.8x of endgame traffic at fanout 6).  Received trajectory
-    # and final totals are bit-identical (A/B-tested); per-window
-    # total_message attribution shifts up to delayhigh ms earlier in the
-    # JSONL log.  "auto" = on iff the EFFECTIVE crash rate is 0: that is
+    # is not even a crash draw.  Suppression never writes such edges into
+    # the mail ring (~4.8x of endgame traffic at fanout 6); their counts
+    # are BANKED per arrival window in EventState.sup_cnt at append time
+    # and credited into total_message when that window drains -- the
+    # exact step their deliveries would have counted -- so every
+    # per-window observable (stdout, JSONL, death tick), not just the
+    # final totals, is bit-identical to the unsuppressed path
+    # (A/B-tested).  On the sharded backend the filter runs pre-exchange
+    # for locally-owned destinations and on the receiving shard for
+    # routed ones (parallel/event_sharded._route_and_append), with the
+    # same deferred crediting.  "auto" = on iff the EFFECTIVE crash rate
+    # is 0: that is
     # crashrate 0, or any crashrate < 0.01 under -compat-reference
     # (whose 1%-resolution Bernoulli truncates the reference's own
     # 0.001 default to 0, simulator.go:180).  Plain crashrate 0.001

@@ -190,7 +190,25 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
                                  and cfg.protocol == "si")
                else 3 * 2**29)
         cap = min(cap, hbm // (4 * max(dw, 1)))
-    return min(cap, (2**31 - 1) // max(dw, 1))
+    # Tail-aware int32 clamp (advisor r5): the flat ring extends to
+    # dw*cap + ring_tail and append diverts trash lanes to indices
+    # dw*cap + lane, so the WHOLE range -- not just dw*cap -- must stay
+    # in int32 or a large explicit -event-slot-cap wraps the trash
+    # indices negative.  ring_tail needs drain_chunk which needs slot_cap
+    # back; the cycle breaks with the PRE-clamp chunk request
+    # (_chunk_want), an upper bound on the real chunk and hence -- via
+    # the same width rule ring_tail applies -- on the real tail.
+    cw = _chunk_want(cfg, n_local)
+    scap = sender_compaction_cap(cfg, cw)
+    width = scap if scap else cw
+    tail_ub = max(cw, width * (cfg.graph_width
+                               + (1 if cfg.protocol == "sir" else 0)))
+    lim = (2**31 - 1 - tail_ub) // max(dw, 1)
+    if lim <= 0:
+        raise ValueError(
+            f"-event-chunk {cfg.event_chunk} implies a ring tail of "
+            f"{tail_ub} lanes, past int32 flat addressing; lower it")
+    return min(cap, lim)
 
 
 def ring_tail(cfg: Config, n_local: int | None = None) -> int:
@@ -230,6 +248,14 @@ def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
     over this range.  The scaled ramp lands within ~3% of all six
     measured optima; the cap keeps low-degree configs (incl. the proven
     1e8 fanout-3 headline at 512k) exactly where their sweeps put them."""
+    return min(slot_cap(cfg, n_local), _chunk_want(cfg, n_local))
+
+
+def _chunk_want(cfg: Config, n_local: int | None = None) -> int:
+    """drain_chunk before its slot_cap clamp (the auto ramp / explicit
+    -event-chunk, >= 256).  Split out so slot_cap's tail-aware int32
+    clamp can bound the ring tail without calling drain_chunk back
+    (which would recurse into slot_cap)."""
     n = n_local if n_local is not None else cfg.n
     if cfg.event_chunk > 0:
         want = cfg.event_chunk
@@ -248,7 +274,7 @@ def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
         # a 918k chunk costs a 1M sort but drains only 918k entries
         # (measured 55.6s vs 49.5s at the 1e8 fanout-6 config).
         want = 1 << (want - 1).bit_length()
-    return min(slot_cap(cfg, n_local), max(256, want))
+    return max(256, want)
 
 
 def init_state(cfg: Config, friends: jnp.ndarray,

@@ -134,6 +134,9 @@ def generate(cfg: Config, key: jax.Array, row0: int = 0, rows: int | None = None
         # gathered, yet at 5e7 x fanout 26 it alone is 5.2 GB -- enough
         # to push the 50M push-pull row off a 16 GB chip.  A one-column
         # placeholder keeps every shape-derived consumer working.
+        # Snapshots written BEFORE this placeholder existed carry the old
+        # (n, fanout) table; prepare_restore_tree coerces them to this
+        # shape on restore (utils/checkpoint.py, advisor r5).
         rows = cfg.n if rows is None else rows
         return (jnp.full((rows, 1), -1, jnp.int32),
                 jnp.zeros((rows,), jnp.int32))

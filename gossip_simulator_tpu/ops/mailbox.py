@@ -47,7 +47,10 @@ def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
     gathers -- dw is tiny), bounds-checked against the slot capacity, with
     overflow counted in `dropped` and overflowed writes diverted to the
     dw*cap trash cell (this platform miscompiled flat OOB-drop scatters;
-    see epidemic.deposit_local).
+    see epidemic.deposit_local).  The same masked-cumsum rank pattern now
+    also buckets the cross-shard exchange (round 6:
+    parallel/exchange.route_multi ranks over the <= RANK_MAX_SHARDS
+    destination columns instead of paying a stable sort per batch).
 
     `rings`/`payloads` are equal-length tuples -- every ring gets the same
     flat positions, so multi-array entries (e.g. the overlay's (dst, pay)

@@ -21,6 +21,21 @@ n_local * dw * B < 2^31 -- 7.1M rows/shard at the default dw=3, B=10; the
 mesh spreads larger n.  Drain-side packing is the same `dst_local * B + off`
 the single-device engine uses.
 
+Round-6 routed-append rework (the 61.6 -> <=51 ns/msg overhead round; every
+piece bit-identical in the zero-overflow regime, see _route_and_append):
+* bucketing is sort-free (exchange.route_multi's one-hot cumsum ranks);
+* duplicate suppression runs PRE-exchange for locally-owned destinations
+  -- at S=1 that is every edge, so suppressed traffic never touches the
+  bucketing path -- with the receiving-side filter kept for routed
+  arrivals;
+* a 1-device mesh appends surviving edges directly (DIRECT_SELF_APPEND):
+  the stable bucket pack + tiled self-all_to_all + unpack is the identity
+  on entry order there, so the whole route is a provable no-op;
+* destination-uniform graphs size the all_to_all payload from the actual
+  per-pair high-water mark (exchange.chernoff_cap) instead of the
+  zero-loss worst case width*kwidth, shrinking wire bytes and the
+  receive-side unpack/filter/append width ~S-fold at S > 1.
+
 Divergences from the single-device event engine: per-shard key folding (the
 same scheme the sharded ring engine uses) decorrelates shards' crash/drop/
 delay streams, so trajectories differ from the single-device run but match
@@ -51,6 +66,12 @@ from gossip_simulator_tpu.parallel.mesh import AXIS, shard_size
 from gossip_simulator_tpu.utils import rng as _rng
 
 I32 = jnp.int32
+
+# Round-6 routed-append switches, monkeypatchable by the A/B parity tests
+# (tests/test_sharded.py pins that flipping either reproduces the same
+# trajectory bit-for-bit); production always runs both True.
+PRE_EXCHANGE_SUPPRESS = True   # filter local-dest duplicates before routing
+DIRECT_SELF_APPEND = True      # S=1: skip the route (it is the identity)
 
 
 def event_state_specs() -> EventState:
@@ -109,20 +130,58 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     shards and append into the local mail ring.
 
     `wslot`/`off` are per-message arrays the same shape as `dst_global`.
-    `flags` non-None enables guaranteed-duplicate suppression on the
-    RECEIVING side (the sharded analog of event.append_messages' append-
-    side filter; sender-side is impossible -- remote destinations' flags
-    live on their owner shard): routed messages whose local destination
-    already has the received bit never enter the ring; they are returned
-    as per-arrival-window counts `sup_adds[dw]` the caller banks in
-    sup_cnt and credits to the psum'd total_message when that window
-    drains -- the same deferred-credit scheme as the single-device
-    append_messages, so per-window observables stay bit-identical.
-    Retained entries keep their relative emission order, so at
-    crash_p == 0 (the Config.dup_suppress_resolved gate) the trajectory
-    is bit-identical.  Returns (mail, cnt, dropped, xovf, sup_adds)."""
+    `flags` non-None enables guaranteed-duplicate suppression.  Since
+    round 6 the filter is split around the exchange: locally-owned
+    destinations (whose received bits live right here -- EVERY destination
+    at S=1, the 1/S local fraction otherwise) are filtered PRE-exchange,
+    so their suppressed edges never enter the bucketing path; routed
+    arrivals keep the RECEIVING-side filter (remote destinations' flags
+    live on their owner shard -- a sender-side check is impossible for
+    them).  Nothing writes flags between route and append, so both halves
+    see the same flags snapshot and together suppress exactly the edges
+    the old post-exchange-only filter did, on the same shard (a local dup's
+    sender IS its receiver) and in the same arrival window -- pinned by
+    tests/test_sharded.py::test_pre_vs_post_exchange_suppression.
+    Suppressed edges are returned as per-arrival-window counts
+    `sup_adds[dw]` the caller banks in sup_cnt and credits to the psum'd
+    total_message when that window drains -- the same deferred-credit
+    scheme as the single-device append_messages, so per-window observables
+    stay bit-identical.  Retained entries keep their relative emission
+    order, so at crash_p == 0 (the Config.dup_suppress_resolved gate) the
+    trajectory is bit-identical.
+
+    One-device meshes (DIRECT_SELF_APPEND) skip the route entirely:
+    bucketing stably prefix-packs survivors and the tiled 1-device
+    all_to_all is the identity, so appending the surviving edges in
+    emission order lands the bit-identical ring -- and exchange_overflow
+    stays structurally 0, which the zero-loss caps already guaranteed
+    there (pinned by test_direct_local_matches_routed and the
+    single-device bit-identity test).  Returns
+    (mail, cnt, dropped, xovf, sup_adds)."""
     b = event.batch_ticks(cfg)
     dw = event.ring_windows(cfg)
+    sup_adds = jnp.zeros((dw,), I32)
+    direct = n_shards == 1 and DIRECT_SELF_APPEND
+    if flags is not None and (PRE_EXCHANGE_SUPPRESS or direct):
+        # Pre-exchange filter on locally-owned destinations.  One-hot
+        # reduction over the tiny dw axis (fuses; a dw-bin scatter-add
+        # would serialize -- see append_messages' oh note).
+        if n_shards == 1:
+            local, dstl = valid, dst_global
+        else:
+            shard = jax.lax.axis_index(AXIS)
+            local = valid & (dst_global // n_local == shard)
+            dstl = dst_global % n_local
+        dup = local & ((flags.at[jnp.where(local, dstl, 0)].get()
+                        & event.RECEIVED) > 0)
+        sup_adds = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+                    & dup[:, None]).sum(axis=0, dtype=I32)
+        valid = valid & ~dup
+    if direct:
+        mail, cnt, dropped = _ring_append(
+            cfg, n_local, mail, cnt, dropped, dst_global * b + off, wslot,
+            valid)
+        return mail, cnt, dropped, xovf, sup_adds
     dest = jnp.where(valid, dst_global // n_local, n_shards)
     wire = jnp.where(
         valid,
@@ -133,13 +192,14 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     rdstl = r // (dw * b)
     rw = (r // b) % dw
     roff = r % b
-    sup_adds = jnp.zeros((dw,), I32)
     if flags is not None:
+        # Receiving-side filter for routed arrivals; locally-destined
+        # duplicates were already gone before the route when the
+        # pre-exchange pass ran (re-checking survivors is a no-op).
         dup = rvalid & ((flags.at[rdstl].get() & event.RECEIVED) > 0)
-        # One-hot reduction over the tiny dw axis (fuses; a dw-bin
-        # scatter-add would serialize -- see append_messages' oh note).
-        sup_adds = ((rw[:, None] == jnp.arange(dw, dtype=I32)[None, :])
-                    & dup[:, None]).sum(axis=0, dtype=I32)
+        sup_adds = sup_adds + (
+            (rw[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+            & dup[:, None]).sum(axis=0, dtype=I32)
         rvalid = rvalid & ~dup
     mail, cnt, dropped = _ring_append(
         cfg, n_local, mail, cnt, dropped, rdstl * b + roff, rw, rvalid)
@@ -181,9 +241,21 @@ def make_sharded_event_step(cfg: Config, mesh):
             f"* B ({b}) must stay below 2^31; use more shards")
     # Same degree-gated sender-compaction width as the single-device step.
     scap = event.sender_compaction_cap(cfg, ccap)
-    # Receiving-side duplicate suppression (_route_and_append docstring);
-    # the resolved gate implies crash_p == 0.
+    # Split pre/post-exchange duplicate suppression (_route_and_append
+    # docstring); the resolved gate implies crash_p == 0.
     suppress = cfg.dup_suppress_resolved
+    # Destination-uniform graphs size each batch's per-pair wire buffer
+    # from the actual high-water mark (mean/S + Chernoff pad; overflow
+    # counted, never silent) instead of the zero-loss worst case -- the
+    # all_to_all payload and the receive-side unpack/filter/append width
+    # shrink ~S-fold at S > 1.  Ring lattices and overlay graphs can
+    # concentrate a batch on one pair (exchange.chernoff_cap's soundness
+    # note), so they keep the zero-loss bound; S = 1 is returned
+    # unchanged (and DIRECT_SELF_APPEND skips the wire there anyway).
+    uniform_dest = cfg.graph in ("kout", "erdos")
+
+    def wire_cap(m_edges: int) -> int:
+        return exchange.chernoff_cap(m_edges, s) if uniform_dest else m_edges
 
     def step_shard(st: EventState, base_key: jax.Array) -> EventState:
         shard = jax.lax.axis_index(AXIS)
@@ -210,13 +282,16 @@ def make_sharded_event_step(cfg: Config, mesh):
         chunks = (jax.lax.pmax(m, AXIS) + ccap - 1) // ccap
         ckey = _rng.tick_key(skey, w, _rng.OP_CRASH)
         kwidth = st.friends.shape[1]
-        rcap = min(exchange.epidemic_cap(n_local, kwidth, s), ccap * kwidth)
-        # Compacted batches carry at most `width` senders; width * kwidth
-        # is the ZERO-LOSS per-pair buffer (a batch cannot emit more edges
-        # than that), matching the dense path's effective lossless
-        # ccap * kwidth -- an epidemic_cap-style mean*safety bound would
-        # drop skewed batches at n_shards > 4.  Computed per batch width
-        # in make_abody (full scap and narrow scap/8 widths).
+        # Dense-path per-pair buffer: the Chernoff high-water cap on
+        # uniform graphs, the round-5 lossless-leaning bound otherwise
+        # (a batch cannot emit more than ccap * kwidth edges).
+        rcap = (wire_cap(ccap * kwidth) if uniform_dest
+                else min(exchange.epidemic_cap(n_local, kwidth, s),
+                         ccap * kwidth))
+        # Compacted batches carry at most `width` senders, so
+        # width * kwidth is their zero-loss bound; wire_cap tightens it
+        # to the per-pair high-water mark on uniform graphs (computed per
+        # batch width in make_abody -- full scap and narrow scap/8).
         cap = cap0
 
         def emit(flags, mail, cnt, dropped, xovf, sids, svalid, sticks,
@@ -225,6 +300,25 @@ def make_sharded_event_step(cfg: Config, mesh):
             SIR removal + local triggers, all_to_all + ring append) at a
             static `width`.  Keys are shard-folded + (tick, local-row)
             keyed, so the draws do not depend on the batch width."""
+            if s == 1 and DIRECT_SELF_APPEND and not sir:
+                # One-device SI mesh: the emission IS the single-device
+                # append -- append_messages draws the identical
+                # (tick, row)-keyed delay/drop streams off the folded
+                # shard key and reserves per sender in the same order the
+                # per-entry path appends (the _route_and_append identity
+                # argument), so the whole decode/rank/append pass below
+                # collapses into the engine the jax backend runs; this is
+                # what lets the S=1 bench twin's ns/msg sit on top of the
+                # single-device row.  SIR keeps the generic path: its
+                # routed form appends batch triggers AFTER batch data,
+                # while append_messages interleaves each sender's trigger
+                # with its edges -- a different (established, pre-round-6)
+                # ring order this rework must not shift.
+                mail, cnt, dropped, sa = event.append_messages(
+                    cfg, mail, cnt, dropped, sids, svalid, sticks,
+                    st.friends, st.friend_cnt, skey,
+                    flags=flags if suppress else None)
+                return flags, mail, cnt, dropped, xovf, sa
             rows = jnp.where(svalid, sids, n_local)
             sidx = jnp.where(svalid, sids, 0)
             sf = st.friends.at[sidx].get()
@@ -311,7 +405,7 @@ def make_sharded_event_step(cfg: Config, mesh):
                         (aflags, amail, acnt, adropped, axovf,
                          sa) = emit(aflags, amail, acnt, adropped, axovf,
                                     bids, bvalid, w * b + btoff, width,
-                                    width * kwidth)
+                                    wire_cap(width * kwidth))
                         return (aflags, amail, acnt, asup + sa[None, :],
                                 adropped, axovf)
                     return abody

@@ -3,17 +3,34 @@ shared-address-space channel sends (`GlobalView[id].ch <- msg`,
 simulator.go:145,154,161).
 
 A shard's outgoing messages (global destination + payload) are bucketed by
-destination shard with the same sort-and-rank machinery as the local mailbox
-(ops/mailbox.py), placed into a fixed-capacity ``[S, cap]`` buffer, and
+destination shard, placed into a fixed-capacity ``[S, cap]`` buffer, and
 exchanged with one `lax.all_to_all` over the "nodes" mesh axis.  Capacity
 overflow is counted (never silently lost) -- with uniform-random destinations
 the per-pair load concentrates at mean/S, so cap = a few x mean/S makes
 overflow astronomically rare (SURVEY §7.3 hard part #4).
 
+Bucketing (round 6): for the small meshes this simulator runs (S <= 16,
+RANK_MAX_SHARDS) the per-bucket rank is ONE-HOT CUMSUM arithmetic over the
+S destination columns -- the same trick the mail ring's append uses over
+its ~3 window slots (ops/mailbox.ring_append) -- instead of the round-1
+stable sort + segment_ranks pass.  The sort was the single heaviest op in
+the routed append (a full lax.sort of width*k lanes PER emission batch;
+see scripts/profile_exchange.py for the measured ratio), and the ranks it
+produced are exactly reproducible without it: an entry's rank within its
+destination bucket is the count of earlier valid entries with the same
+destination, which the masked cumsum computes in one pass.  Buffer
+contents are bit-identical to the sorted path (positions (dest, rank) are
+unique, survivors keep emission order) -- pinned by
+tests/test_sharded.py::test_route_multi_rank_matches_sort.  Meshes wider
+than RANK_MAX_SHARDS (where the M x S one-hot workspace would outgrow the
+sorted form) keep the sort path.
+
 All functions run INSIDE shard_map.
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -23,14 +40,18 @@ from gossip_simulator_tpu.parallel.mesh import AXIS
 
 I32 = jnp.int32
 
+# Widest mesh the one-hot bucketing rank serves; beyond it the M x S
+# cumsum workspace grows past what the sort pass costs.  Every mesh this
+# repo targets (v5e-8, the 8-fake-device CPU shim) is far inside it.
+RANK_MAX_SHARDS = 16
+
 
 def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
-                n_shards: int, cap: int, axis: str = AXIS):
+                n_shards: int, cap: int, axis: str = AXIS,
+                sort_buckets: bool | None = None):
     """Exchange several int32 payload arrays that share one (dest, valid)
-    keying: ONE stable sort carries all payloads, the per-payload buffers
-    concatenate into a single all_to_all.  Same fast pattern as
-    ops/mailbox.deliver (payload-carrying sort, flat scatter with an
-    in-bounds trash cell -- 2-D index scatters are ~15x slower here).
+    keying: one bucketing-rank pass carries all payloads, the per-payload
+    buffers concatenate into a single all_to_all.
 
     Args:
         payloads: tuple of int32[M] (each >= 0 for valid messages; -1 is
@@ -39,27 +60,59 @@ def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
         valid: bool[M].
         n_shards: mesh size S.
         cap: per-destination-shard buffer slots.
+        sort_buckets: None (auto: sort only past RANK_MAX_SHARDS), or
+            force the sort (True) / one-hot cumsum (False) rank path --
+            the two produce bit-identical buffers (module docstring);
+            the override exists for the profiler and the parity test.
 
     Returns:
         recvs: tuple of int32[S*cap] received payloads (-1 = empty slot),
             slot-aligned across payloads.
         overflow: int32[] messages dropped for capacity locally.
     """
+    if sort_buckets is None:
+        sort_buckets = n_shards > RANK_MAX_SHARDS
     key = jnp.where(valid, dest_shard, n_shards).astype(I32)
-    srt = jax.lax.sort((key, *[p.astype(I32) for p in payloads]),
-                       num_keys=1, is_stable=True)
-    sk, sps = srt[0], srt[1:]
-    rank = segment_ranks(sk)
-    ok = (sk < n_shards) & (rank < cap)
-    flat = jnp.where(ok, sk * cap + rank, n_shards * cap)  # trash cell
+    if sort_buckets:
+        # Stable sort + segment ranks (the round-1 path, kept for wide
+        # meshes): flat scatter with an in-bounds trash cell -- 2-D index
+        # scatters are ~15x slower here (ops/mailbox.deliver's NOTE).
+        srt = jax.lax.sort((key, *[p.astype(I32) for p in payloads]),
+                           num_keys=1, is_stable=True)
+        sk, sps = srt[0], srt[1:]
+        rank = segment_ranks(sk)
+        ok = (sk < n_shards) & (rank < cap)
+        flat = jnp.where(ok, sk * cap + rank, n_shards * cap)  # trash cell
+        vals = [jnp.where(ok, sp, -1) for sp in sps]
+        overflow = ((sk < n_shards) & (rank >= cap)).sum(dtype=I32)
+    else:
+        # Sort-free: rank within the destination bucket = count of earlier
+        # valid entries with the same destination (masked cumsum over the
+        # S one-hot columns).  Scatter positions (dest, rank) are unique
+        # for valid lanes, so the unsorted scatter lands the identical
+        # buffer; overflowed and invalid lanes share the trash cell
+        # (all write -1, order-free).
+        oh = ((key[:, None] == jnp.arange(n_shards, dtype=I32)[None, :])
+              .astype(I32))
+        rank = (jnp.cumsum(oh, axis=0) * oh).sum(axis=1) - 1
+        ok = (key < n_shards) & (rank < cap)
+        flat = jnp.where(ok, key * cap + rank, n_shards * cap)
+        vals = [jnp.where(ok, p.astype(I32), -1) for p in payloads]
+        overflow = ((key < n_shards) & (rank >= cap)).sum(dtype=I32)
     bufs = []
-    for sp in sps:
+    for v in vals:
         buf = jnp.full((n_shards * cap + 1,), -1, I32)
-        bufs.append(buf.at[flat].set(jnp.where(ok, sp, -1))
+        bufs.append(buf.at[flat].set(v)
                     [:n_shards * cap].reshape(n_shards, cap))
-    overflow = ((sk < n_shards) & (rank >= cap)).sum(dtype=I32)
-    recv = jax.lax.all_to_all(jnp.concatenate(bufs, axis=1), axis,
-                              split_axis=0, concat_axis=0, tiled=True)
+    stacked = jnp.concatenate(bufs, axis=1)
+    if n_shards > 1:
+        recv = jax.lax.all_to_all(stacked, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    else:
+        # A tiled 1-device all_to_all is the identity; skip the collective
+        # (every S=1 route caller -- the routing-constant bench twins, the
+        # ring engine's deliveries, the overlay -- pays it per batch).
+        recv = stacked
     recvs = tuple(recv[:, i * cap:(i + 1) * cap].reshape(-1)
                   for i in range(len(bufs)))
     return recvs, overflow
@@ -67,10 +120,10 @@ def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
 
 def route_one(payload: jnp.ndarray, dest_shard: jnp.ndarray,
               valid: jnp.ndarray, n_shards: int, cap: int,
-              axis: str = AXIS):
+              axis: str = AXIS, sort_buckets: bool | None = None):
     """Exchange one int32 payload array (see route_multi)."""
     (recv,), overflow = route_multi((payload,), dest_shard, valid, n_shards,
-                                    cap, axis)
+                                    cap, axis, sort_buckets=sort_buckets)
     return recv, overflow
 
 
@@ -80,6 +133,25 @@ def epidemic_cap(n_local: int, k: int, n_shards: int, safety: int = 4) -> int:
     Clamped to the zero-loss bound n_local*k (can't exceed the edge count)."""
     mean = max(1, (n_local * k) // max(n_shards, 1))
     return int(min(n_local * k, max(64, safety * mean)))
+
+
+def chernoff_cap(m_edges: int, n_shards: int) -> int:
+    """Per-pair wire cap for a batch of `m_edges` uniform-random-destination
+    messages over `n_shards`: the actual per-pair high-water mark
+    (mean = m/S) plus a Chernoff pad, instead of the zero-loss worst case
+    m_edges.  pad = max(64, 8*sqrt(mean)) puts the per-(pair, batch)
+    overflow probability near exp(-32) ~ 1e-14 (multiplicative Chernoff,
+    P[X > mean + d] <= exp(-d^2 / (2 mean + 2d/3)) for binomial X) --
+    astronomically rare over any run's batch count, and overflow is
+    counted in exchange_overflow, never silent.  SOUND ONLY for
+    destination-uniform graphs (kout, erdos -- every pick is uniform over
+    [0, n)); ring lattices and settled overlays can concentrate a whole
+    batch on one pair, so callers gate on graph type and fall back to the
+    zero-loss bound (the `min` keeps small batches lossless either way)."""
+    if n_shards <= 1:
+        return m_edges
+    mean = -(-m_edges // n_shards)
+    return int(min(m_edges, mean + max(64, math.ceil(8 * math.sqrt(mean)))))
 
 
 def pack_dst_slot(dst_local: jnp.ndarray, dslot: jnp.ndarray, d: int):

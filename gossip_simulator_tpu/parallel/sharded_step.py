@@ -104,10 +104,18 @@ def make_sharded_tick(cfg: Config, mesh):
             ccap = epidemic.compact_chunk_cap(cfg, n_local)
             count = jax.lax.pmax(senders.sum(dtype=I32), AXIS)
             chunks = (count + ccap - 1) // ccap
-            # Per-chunk route cap: never below the dense path's (so any wave
-            # dense delivers losslessly, compact does too -- skew included),
-            # bounded above by a chunk's absolute max emission.
-            rcap = min(exchange.epidemic_cap(n_local, width, s), ccap * width)
+            # Per-chunk route cap: destination-uniform graphs size the
+            # wire from the per-pair high-water mark (round 6 --
+            # exchange.chernoff_cap, same gate as the event engine's
+            # wire_cap; counted overflow, never silent); others keep the
+            # round-1 rule -- never below the dense path's cap (so any
+            # wave dense delivers losslessly, compact does too, skew
+            # included), bounded above by a chunk's absolute max emission.
+            if cfg.graph in ("kout", "erdos"):
+                rcap = exchange.chernoff_cap(ccap * width, s)
+            else:
+                rcap = min(exchange.epidemic_cap(n_local, width, s),
+                           ccap * width)
 
             def body(_, carry):
                 pending, remaining, ovf = carry

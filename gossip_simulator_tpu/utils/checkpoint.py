@@ -80,6 +80,15 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
     if n != cfg.n:
         raise ValueError(
             f"checkpoint has n={n} but this run has n={cfg.n}")
+    if (cfg.protocol == "pushpull" and "friends" in tree
+            and tuple(tree["friends"].shape[1:]) != (1,)):
+        # Pre-round-5 pushpull snapshot: friends was the full (n, fanout)
+        # table.  The protocol never reads it, but graphs.generate now
+        # returns a one-column placeholder and every traced step was built
+        # on that shape -- coerce instead of silently carrying the old
+        # geometry into a shape-mismatched restore (advisor r5).
+        tree["friends"] = np.full((n, 1), -1, np.int32)
+        tree["friend_cnt"] = np.zeros((n,), np.int32)
     if ckpt_engine == "event":
         n_local = n // n_shards
         dw = event.ring_windows(cfg)

@@ -22,6 +22,17 @@ def setup(cache_dir: str | None = None) -> None:
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    # Cache every entry regardless of size, and (where this jax exposes
+    # it) XLA's own autotuning/kernel caches too: the flagship 100M row's
+    # first call is ~52 s of trace + compile (`graph_s` in the bench
+    # record, README "cold-start" note) and the persistent cache is what
+    # makes every rerun of the same (config, shape) start warm.
+    for knob, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                      ("jax_persistent_cache_enable_xla_caches", "all")):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):  # knob absent on this jax
+            pass
 
 
 def forced_cpu_env(n_devices: int,

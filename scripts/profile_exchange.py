@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Profile the sharded engine's routed-append components (VERDICT r5 #1).
+
+Round 5 measured the sharded event engine 27% over the single-device
+engine per delivered message on a 1-device mesh (61.6 vs 48.6 ns/msg at
+50M/99%) -- pure routing/bucketing machinery with zero real ICI traffic.
+This script times that machinery in isolation, on THIS host's devices
+(TPU when the axon pool is up, CPU otherwise), so the per-component
+constants behind the README v5e-8 projection are measured, not assumed:
+
+  * `route`: exchange.route_one bucket+exchange cost on an S-shard mesh,
+    round-1 sort path vs round-6 one-hot rank path, per lane count;
+  * `append_s1`: one emission batch's append on a 1-device mesh three
+    ways -- direct ring append (DIRECT_SELF_APPEND, what the S=1 bench
+    twin now runs), rank-routed, sort-routed (what it ran in round 5) --
+    the eliminated work is the difference between the columns;
+  * `wire_cap`: the S-shard route at the zero-loss per-pair cap vs
+    exchange.chernoff_cap -- the payload/unpack width the high-water
+    sizing removes.
+
+Each row reports seconds/call and ns/lane.  Results land in one JSON
+(default PROFILE_EXCHANGE.json next to the repo's other artifacts);
+nothing here mutates simulator state.
+
+Usage:
+    python scripts/profile_exchange.py                  # defaults
+    python scripts/profile_exchange.py --m 3145728 --shards 8 --iters 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_simulator_tpu.utils import jaxsetup  # noqa: E402
+
+jaxsetup.setup()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from gossip_simulator_tpu.ops.mailbox import ring_append  # noqa: E402
+from gossip_simulator_tpu.parallel import exchange  # noqa: E402
+from gossip_simulator_tpu.parallel.mesh import (AXIS, node_mesh,  # noqa: E402
+                                                shard_map)
+
+DW, B = 3, 10  # the default-config ring geometry (delaylow 10 -> B=10, dw=3)
+
+
+def _timeit(fn, args, iters: int) -> float:
+    out = fn(*args)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _route_inputs(s: int, m: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    payload = rng.integers(0, 1 << 20, (s, m), dtype=np.int32)
+    dest = rng.integers(0, s, (s, m), dtype=np.int32)
+    valid = rng.random((s, m)) < 0.9
+    return payload, dest, valid
+
+
+def profile_route(s: int, m: int, cap: int, iters: int,
+                  sort_buckets: bool) -> float:
+    """One route_one call per shard on an s-device mesh (cap per pair)."""
+    mesh = node_mesh(s)
+
+    def body(payload, dest, valid):
+        recv, ovf = exchange.route_one(payload[0], dest[0], valid[0], s,
+                                       cap, sort_buckets=sort_buckets)
+        return recv[None], ovf[None]
+
+    fn = jax.jit(shard_map(body, mesh=mesh,
+                           in_specs=(P(AXIS, None),) * 3,
+                           out_specs=(P(AXIS, None), P(AXIS))))
+    return _timeit(fn, _route_inputs(s, m), iters)
+
+
+def profile_append_s1(m: int, iters: int) -> dict:
+    """One emission batch's append into the mail ring on ONE device:
+    direct (the round-6 S=1 path), rank-routed, sort-routed (round 5).
+    route_one at n_shards=1 never calls the collective, so this runs
+    outside shard_map -- the op sequence is identical to the engine's."""
+    n_local = max(1024, m)
+    cap = m
+    rng = np.random.default_rng(0)
+    ring = np.zeros((DW * cap + m,), np.int32)  # tail = one batch's lanes
+    cnt = np.zeros((1, DW), np.int32)
+    dst = rng.integers(0, n_local, (m,), dtype=np.int32)
+    wslot = rng.integers(0, DW, (m,), dtype=np.int32)
+    off = rng.integers(0, B, (m,), dtype=np.int32)
+    valid = rng.random((m,)) < 0.9
+
+    @jax.jit
+    def direct(ring, cnt, dst, wslot, off, valid):
+        return ring_append((ring,), cnt, jnp.zeros((), jnp.int32),
+                           (dst * B + off,), wslot, valid, DW, cap)
+
+    def routed(sort):
+        @jax.jit
+        def f(ring, cnt, dst, wslot, off, valid):
+            wire = jnp.where(valid, dst * (DW * B) + wslot * B + off, -1)
+            dest = jnp.zeros(dst.shape, jnp.int32)
+            recv, ovf = exchange.route_one(wire, dest, valid, 1, m,
+                                           sort_buckets=sort)
+            rv = recv >= 0
+            r = jnp.maximum(recv, 0)
+            return ring_append(
+                (ring,), cnt, ovf, ((r // (DW * B)) * B + r % B,),
+                (r // B) % DW, rv, DW, cap)
+        return f
+
+    args = (ring, cnt, dst, wslot, off, valid)
+    return {
+        "direct_s": _timeit(direct, args, iters),
+        "rank_routed_s": _timeit(routed(False), args, iters),
+        "sort_routed_s": _timeit(routed(True), args, iters),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--m", type=int, default=None,
+                    help="lanes per batch (default: 786432 on TPU, "
+                         "98304 on CPU)")
+    ap.add_argument("--shards", type=int, default=None,
+                    help="mesh size for the route rows (default: all "
+                         "devices, capped at 8)")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PROFILE_EXCHANGE.json"))
+    args = ap.parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    m = args.m or (786_432 if on_tpu else 98_304)
+    s = args.shards or min(jax.device_count(), 8)
+    rec = {"device": jax.devices()[0].device_kind,
+           "backend": jax.default_backend(),
+           "m": m, "shards": s, "iters": args.iters, "rows": {}}
+
+    # S=1 append three ways: the eliminated-work ledger for the bench twin.
+    a = profile_append_s1(m, args.iters)
+    a["ns_per_lane"] = {k[:-2]: v * 1e9 / m for k, v in a.items()}
+    rec["rows"]["append_s1"] = a
+
+    if s > 1:
+        zl = m  # zero-loss per-pair cap (a batch cannot exceed its lanes)
+        ch = exchange.chernoff_cap(m, s)
+        rows = {}
+        for name, cap, sort in (
+                ("sort_zero_loss", zl, True),
+                ("rank_zero_loss", zl, False),
+                ("rank_chernoff", ch, False)):
+            t = profile_route(s, m, cap, args.iters, sort)
+            rows[name] = {"cap": cap, "s_per_call": t,
+                          "ns_per_lane": t * 1e9 / m}
+        rec["rows"]["route"] = rows
+
+    with open(args.out, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps({k: v for k, v in rec.items() if k != "rows"}
+                     | {"out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

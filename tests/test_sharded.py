@@ -160,6 +160,132 @@ def test_graft_entry_points():
     g.dryrun_multichip(8)
 
 
+def test_route_multi_rank_matches_sort():
+    """Round-6 sort-free bucketing: the one-hot cumsum rank path must land
+    the bit-identical exchange buffers (and overflow count) the round-1
+    stable-sort path did -- incl. under per-pair capacity overflow, where
+    both drop the same per-bucket suffix."""
+    from jax.sharding import PartitionSpec as P
+
+    from gossip_simulator_tpu.parallel.mesh import shard_map
+
+    mesh = node_mesh(8)
+    rng = np.random.default_rng(11)
+    payload = rng.integers(0, 1 << 20, (8, 512), dtype=np.int32)
+    dest = rng.integers(0, 8, (8, 512), dtype=np.int32)
+    valid = rng.random((8, 512)) < 0.8
+
+    def run(cap, sort_buckets):
+        def body(p, d, v):
+            recv, ovf = exchange.route_one(p[0], d[0], v[0], 8, cap,
+                                           sort_buckets=sort_buckets)
+            return recv[None], ovf[None]
+
+        fn = jax.jit(shard_map(body, mesh=mesh,
+                               in_specs=(P("nodes", None),) * 3,
+                               out_specs=(P("nodes", None), P("nodes"))))
+        recv, ovf = fn(payload, dest, valid)
+        return np.asarray(recv), np.asarray(ovf)
+
+    for cap in (128, 24):  # lossless and forced-overflow regimes
+        rs, os_ = run(cap, True)
+        rr, or_ = run(cap, False)
+        np.testing.assert_array_equal(rs, rr)
+        np.testing.assert_array_equal(os_, or_)
+    assert run(24, False)[1].sum() > 0  # the overflow case actually fired
+
+
+def _window_trace(stepper, cfg, max_windows=200):
+    """Drive gossip windows, returning the per-window counter tuples the
+    parity tests compare (the poll-cadence observable surface)."""
+    rows = []
+    for _ in range(max_windows):
+        st = stepper.gossip_window()
+        rows.append((st.round, st.total_received, st.total_message,
+                     st.total_crashed, st.mailbox_dropped,
+                     st.exchange_overflow))
+        if st.coverage >= cfg.coverage_target or stepper.exhausted:
+            break
+    return rows
+
+
+def test_sharded_event_bit_identical_to_single_device():
+    """THE routed-path parity pin (round 6): on a 1-device mesh the
+    reworked sharded event engine must reproduce the single-device event
+    engine bit-for-bit, per window -- totals, coverage, and counters --
+    modulo only the documented per-shard key fold (skey =
+    fold_in(base_key, shard); the seed draw is unfolded on both paths).
+    This holds because the direct S=1 append (DIRECT_SELF_APPEND) lands
+    the identical ring layout append_messages does: entries in emission
+    order, per-slot prefix reservations, same pre-append duplicate
+    filter."""
+    from gossip_simulator_tpu.models import event, graphs
+    from gossip_simulator_tpu.models.state import msg64_value
+    from gossip_simulator_tpu.utils import rng as _rng
+
+    cfg = Config(**BASE, backend="sharded", progress=False).validate()
+    assert cfg.engine_resolved == "event" and cfg.dup_suppress_resolved
+    s = ShardedStepper(cfg, n_devices=1)
+    s.init()
+    s.seed()
+    sharded_rows = _window_trace(s, cfg)
+
+    key = _rng.base_key(cfg.seed)
+    fkey = jax.random.fold_in(key, 0)  # the shard-0 step-key fold
+    friends, cnt = graphs.generate(cfg, graphs.graph_key(cfg))
+    st = event.init_state(cfg, friends, cnt)
+    st = event.make_seed_fn(cfg)(st, key)
+    step = jax.jit(event.make_window_step_fn(cfg))
+    single_rows = []
+    for _ in range(len(sharded_rows)):
+        st = step(st, fkey)
+        single_rows.append((
+            int(st.tick), int(st.total_received),
+            msg64_value(np.asarray(st.total_message)),
+            int(st.total_crashed), int(st.mail_dropped), 0))
+    assert sharded_rows == single_rows
+
+
+def test_pre_vs_post_exchange_suppression(monkeypatch):
+    """Round-6 A/B: filtering locally-owned duplicate destinations BEFORE
+    the exchange must reproduce the round-5 post-exchange-only filter's
+    trajectory exactly -- both halves see the same flags snapshot, so
+    they suppress the same edges on the same shard into the same arrival
+    window (the _route_and_append docstring's argument, pinned here on
+    the 8-shard mesh)."""
+    from gossip_simulator_tpu.parallel import event_sharded
+
+    def run(pre):
+        monkeypatch.setattr(event_sharded, "PRE_EXCHANGE_SUPPRESS", pre)
+        cfg = Config(**BASE, backend="sharded", progress=False).validate()
+        assert cfg.dup_suppress_resolved
+        return run_simulation(cfg, printer=ProgressPrinter(enabled=False))
+
+    rpre = run(True)
+    rpost = run(False)
+    assert rpre.stats == rpost.stats
+    assert rpre.coverage_ms == rpost.coverage_ms
+    assert rpre.converged and rpre.stats.exchange_overflow == 0
+
+
+def test_direct_local_matches_routed(monkeypatch):
+    """Round-6 A/B: the S=1 direct append must reproduce the full route
+    path (bucket pack + tiled self-all_to_all + unpack) exactly -- the
+    route is the identity on entry order there, so skipping it cannot
+    move a single counter."""
+    from gossip_simulator_tpu.parallel import event_sharded
+
+    def run(direct):
+        monkeypatch.setattr(event_sharded, "DIRECT_SELF_APPEND", direct)
+        cfg = Config(**BASE, backend="sharded", progress=False).validate()
+        s = ShardedStepper(cfg, n_devices=1)
+        s.init()
+        s.seed()
+        return _window_trace(s, cfg)
+
+    assert run(True) == run(False)
+
+
 def test_sharded_narrow_tail_same_totals(monkeypatch):
     """Sharded narrow-tail batching: with crashrate=0 the drain's global
     per-window (id, toff) sort makes totals and timing invariant to the
