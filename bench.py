@@ -282,39 +282,95 @@ def capture_exchange_profile(detail: dict) -> None:
         detail["exchange_profile"] = {"error": repr(e)}
 
 
-def capture_100m_two_phase(detail: dict, seed: int) -> None:
+def capture_100m_two_phase(detail: dict, seed: int,
+                           phase1_twins: bool = True) -> None:
     """VERDICT r3 #3: the full reference-default two-phase pipeline at
     flagship scale -- 100M-node dynamic-overlay construction (rounds
     mode, the auto split-round memory path) chained into the epidemic
     phase on one chip.  fanout 5 is the reference default; coverage 0.90
     is its honest done-line (5 x 0.9 drop asymptotes ~98.9% < 99%,
-    SURVEY 5.3a).  Run ONCE (no warm/timed double pass -- the build is
-    ~10+ minutes); wall time includes compile."""
+    SURVEY 5.3a).  Each row runs ONCE (no warm/timed double pass); wall
+    time includes compile -- the `wall_warm_s` field subtracts the
+    telemetry-recorded compile share when available.
+
+    Round 7 (`phase1_twins`): A/B twin rows isolate each phase-1 gate's
+    contribution (ISSUE 4 acceptance) -- `two_phase_100m` runs the
+    round-7 defaults, `_pre` forces every gate off (the bit-exact
+    pre-round-7 pipeline), and the three single-gate-off rows subtract
+    one lever each.  The membership multiset of `_pre` at the pinned
+    seed is the round-6 result by construction (gates off = the old
+    code paths; pinned at CPU scale by tests/test_overlay_phase1.py)."""
     from gossip_simulator_tpu.driver import run_simulation
     from gossip_simulator_tpu.utils.metrics import ProgressPrinter
 
-    cfg = Config(n=100_000_000, graph="overlay", fanout=5, seed=seed,
-                 coverage_target=0.90, backend="jax",
-                 progress=False).validate()
-    t0 = time.perf_counter()
+    base = Config(n=100_000_000, graph="overlay", fanout=5, seed=seed,
+                  coverage_target=0.90, backend="jax",
+                  progress=False).validate()
+    rows = [("two_phase_100m", base)]
+    if phase1_twins:
+        rows += [
+            ("two_phase_100m_pre", base.replace(
+                overlay_static_boot="off", overlay_adaptive_chunks="off",
+                overlay_dead_skip="off")),
+            ("two_phase_100m_dynboot", base.replace(
+                overlay_static_boot="off")),
+            ("two_phase_100m_noadaptive", base.replace(
+                overlay_adaptive_chunks="off")),
+            ("two_phase_100m_nodeadskip", base.replace(
+                overlay_dead_skip="off")),
+        ]
+    for name, cfg in rows:
+        t0 = time.perf_counter()
+        try:
+            # Context-managed printer: closed even if the near-ceiling run
+            # faults (metrics.ProgressPrinter.__exit__).
+            with ProgressPrinter(False) as printer:
+                res = run_simulation(cfg, printer=printer)
+            detail[name] = {
+                "n": cfg.n, "overlay_mode": cfg.overlay_mode_resolved,
+                "overlay_windows": res.overlay_windows,
+                "stabilize_sim_ms": res.stabilize_ms,
+                "quiesced": True,  # run_simulation raises otherwise
+                "coverage": res.stats.coverage,
+                "total_message": res.stats.total_message,
+                "mailbox_dropped": res.stats.mailbox_dropped,
+                "converged": res.converged,
+                "gates": {
+                    "static_boot": cfg.overlay_static_boot,
+                    "adaptive_chunks": cfg.overlay_adaptive_chunks,
+                    "dead_skip": cfg.overlay_dead_skip,
+                },
+                "wall_s": round(time.perf_counter() - t0, 1),
+            }
+        except Exception as e:  # record, don't kill the record
+            detail[name] = {"error": repr(e)}
+
+
+def capture_overlay_profile(detail: dict) -> None:
+    """Phase-1 cost-floor micro-profile (scripts/profile_overlay.py run
+    in-process -- a subprocess would open a second TPU client while this
+    one is live): the per-chunk scatter/scan and per-row popcount
+    constants the README phase-1 cost-model table cites."""
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
     try:
-        # Context-managed printer: closed even if the near-ceiling run
-        # faults (metrics.ProgressPrinter.__exit__).
-        with ProgressPrinter(False) as printer:
-            res = run_simulation(cfg, printer=printer)
-        detail["two_phase_100m"] = {
-            "n": cfg.n, "overlay_mode": cfg.overlay_mode_resolved,
-            "overlay_windows": res.overlay_windows,
-            "stabilize_sim_ms": res.stabilize_ms,
-            "quiesced": True,  # run_simulation raises otherwise
-            "coverage": res.stats.coverage,
-            "total_message": res.stats.total_message,
-            "mailbox_dropped": res.stats.mailbox_dropped,
-            "converged": res.converged,
-            "wall_s": round(time.perf_counter() - t0, 1),
+        spec = importlib.util.spec_from_file_location(
+            "profile_overlay",
+            os.path.join(here, "scripts", "profile_overlay.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        n = 16_777_216 if jax.default_backend() == "tpu" else 1_048_576
+        from gossip_simulator_tpu.models import overlay as _ov
+        cap = Config(n=n).mailbox_cap_for(n)
+        widths = _ov.hosted_chunk_widths(Config(n=n), n)
+        detail["overlay_profile"] = {
+            "n": n, "cap": cap, "widths": list(widths),
+            "chunk_floor": mod.profile_chunk_floor(n, cap, widths, 3),
+            "row_floor": mod.profile_row_floor(n, cap, 5),
         }
     except Exception as e:  # record, don't kill the record
-        detail["two_phase_100m"] = {"error": repr(e)}
+        detail["overlay_profile"] = {"error": repr(e)}
 
 
 def capture_scale50(detail: dict, seed: int) -> None:
@@ -489,10 +545,18 @@ def full_suite(seed: int) -> list[dict]:
     # phase, simulator.go:219-235): 1M nodes single-chip, default rounds
     # mode AND the tick-faithful engine (per-message delays, the
     # reference's true stabilization clock -- `-overlay-mode ticks`).
-    for name, mode in (("overlay_1m_phase1", "rounds"),
-                       ("overlay_1m_ticks", "ticks")):
+    for name, on, mode in (("overlay_1m_phase1", 1_000_000, "rounds"),
+                           ("overlay_1m_ticks", 1_000_000, "ticks"),
+                           # Round 7 (VERDICT r5 #3): the raised
+                           # OVERLAY_TICKS_AUTO_MAX band's anchor row --
+                           # 10M true-per-message-clock construction,
+                           # justified against overlay_10m_phase1's
+                           # rounds-mode cost (<= 2x budget; README
+                           # "Overlay mode at scale").
+                           ("overlay_10m_phase1", 10_000_000, "rounds"),
+                           ("overlay_10m_ticks", 10_000_000, "ticks")):
         try:
-            ocfg = Config(n=1_000_000 // scale, graph="overlay",
+            ocfg = Config(n=on // scale, graph="overlay",
                           overlay_mode=mode, backend="jax",
                           seed=seed, progress=False).validate()
             r = _bench_overlay(ocfg)
@@ -534,6 +598,7 @@ def main() -> int:
                 json.dump(result, fh)
             capture_sharded_1chip(result["detail"], args.seed)
             capture_exchange_profile(result["detail"])
+            capture_overlay_profile(result["detail"])
             capture_scale50(result["detail"], args.seed)
             # Refresh the salvage so a worker fault in the near-ceiling
             # 100M rows can't discard the just-measured sharded twins.
@@ -560,7 +625,7 @@ def main() -> int:
     line = {k: v for k, v in result.items() if k != "detail"}
     d = result["detail"]
     for row in ("jax_100m_99pct", "jax_100m_99pct_nosuppress", "jax_100m",
-                "two_phase_100m"):
+                "two_phase_100m", "two_phase_100m_pre"):
         if row in d and "error" not in d[row]:
             line[row + "_s"] = round(
                 d[row].get("run_s", d[row].get("wall_s", 0.0)) or 0.0, 2)

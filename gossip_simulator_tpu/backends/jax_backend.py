@@ -86,7 +86,10 @@ class JaxStepper(Stepper):
                 self._oround = overlay.make_split_round_fn(cfg)
             else:
                 self._oround = jax.jit(overlay.make_round_fn(cfg))
-            self.ostate = overlay.init_state(cfg) if build_state else None
+            # base_key: the static-bootstrap band draws the initial
+            # friends table + burst at init (overlay.init_state).
+            self.ostate = (overlay.init_state(cfg, base_key=self.key)
+                           if build_state else None)
         self._overlay_done = False
         self._orun = None  # lazy: compiled only on the fast path
         self.state = None
@@ -118,9 +121,14 @@ class JaxStepper(Stepper):
         self._overlay_rounds += 1
         faithful = self._faithful_overlay
         tick = self.ostate.tick if faithful else 0
-        mk, bk, q, tick = jax.device_get(
-            (self.ostate.win_makeups, self.ostate.win_breakups,
-             self._quiesced_jit()(self.ostate), tick))
+        # Split rounds with the dead-row skip already computed quiescence
+        # from the emission counts (overlay.make_split_round_fn); the
+        # eager predicate reduces multi-GB masks at memory scale.
+        q_fast = getattr(self._oround, "last_quiesced", None)
+        mk, bk, tick = jax.device_get(
+            (self.ostate.win_makeups, self.ostate.win_breakups, tick))
+        q = (q_fast if q_fast is not None
+             else jax.device_get(self._quiesced_jit()(self.ostate)))
         # True simulated ms from the tick clock in faithful mode; the
         # rounds engine only estimates rounds x mean_delay.
         self._phase1_ms = (float(tick) if faithful
@@ -160,17 +168,25 @@ class JaxStepper(Stepper):
                 self._advance_overlay()
                 self._overlay_rounds += 1
                 self._phase1_ms = self._overlay_rounds * self._mean_delay
+                # Round 7: with the dead-row skip on, the split round
+                # computes quiescence from the emission counts INSIDE the
+                # jitted b2 call (one scalar) -- the eager quiesced()
+                # otherwise reduces the (cap, n) emission masks every
+                # round (~6.4 GB of reads at n=1e8).
+                q_fast = getattr(self._oround, "last_quiesced", None)
                 if telem is not None:
                     st = self.ostate
-                    q, mk, bk, dr = jax.device_get(
-                        (oq(st), st.win_makeups, st.win_breakups,
+                    mk, bk, dr = jax.device_get(
+                        (st.win_makeups, st.win_breakups,
                          st.mailbox_dropped))
                     telem.overlay_host_row(
                         [self._overlay_rounds, int(mk), int(bk), int(dr)])
                     telem.tally_overlay_call(time.perf_counter() - t0)
-                    q = bool(q)
+                    q = (bool(q_fast) if q_fast is not None
+                         else bool(jax.device_get(oq(self.ostate))))
                 else:
-                    q = bool(jax.device_get(oq(self.ostate)))
+                    q = (bool(q_fast) if q_fast is not None
+                         else bool(jax.device_get(oq(self.ostate))))
                 if q:
                     break
             if q:

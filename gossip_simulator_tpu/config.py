@@ -38,10 +38,30 @@ PROTOCOLS = ("si", "pushpull", "sir")
 GRAPHS = ("overlay", "kout", "erdos", "ring")
 TIME_MODES = ("ticks", "rounds")
 ENGINES = ("auto", "ring", "event")
-# overlay_mode="auto" picks the tick-faithful phase-1 engine up to this n
-# (measured: ticks costs ~0.5s at 100k, ~11s at 1M, 3-4x rounds mode
-# above -- README "Overlay mode at scale").
-OVERLAY_TICKS_AUTO_MAX = 1_000_000
+# overlay_mode="auto" picks the tick-faithful phase-1 engine up to this n.
+# Round 7 raised the band 1M -> 10M: the prefix-dense drain delivery
+# (ops.mailbox deliver_pair prefix path -- the drained ring's live
+# entries are a sorted PREFIX, so the per-chunk compaction scans that
+# dominated the 10M chunk sweep are pure waste) brought the 10M ticks
+# build inside the <=2x-rounds-mode budget the fidelity default demands
+# (README "Overlay mode at scale"; scripts/profile_overlay.py measures
+# the per-chunk scan/sort/scatter constants the raise cites).  Above
+# 10M the estimated clock (within ~1 window of true at 1M/10M, r3)
+# remains the default and the driver prints the notice.
+OVERLAY_TICKS_AUTO_MAX = 10_000_000
+# overlay_static_boot="auto" band: at and above this many rows the
+# single-device ROUNDS overlay draws the whole initial friends table and
+# emits the n*fanout makeup burst at round 0 (the way overlay_ticks.
+# init_state always has -- the reference's needNewFriend loop re-arms
+# with no delay, simulator.go:103-105, so a node fills all fanout slots
+# at t~0 and, once at fanout, can never drop below it again).  A
+# deterministic re-choice of the bootstrap schedule, same as the column
+# band's arrival-order re-choice: every n below the band is bit-identical
+# to round 6; the band sits at the split-round boundary
+# (overlay.SPLIT_ROUND_MIN_ROWS) where the staggered per-round burst was
+# the measured dominant phase-1 cost.  Module-level so CPU tests can
+# lower it and pin both trajectories.
+OVERLAY_STATIC_BOOT_MIN_ROWS = 32_000_000
 # The auto mailbox cap drops 16 -> 8 at this many local rows (see
 # Config.mailbox_cap_for: emission-buffer memory, not overflow risk,
 # is what the cap costs at scale).
@@ -152,6 +172,31 @@ class Config:
     # native/cpp are inherently faithful (discrete-event) and ignore the
     # flag.
     overlay_mode: str = "auto"
+    # --- phase-1 speed-round gates (round 7; rounds overlay engine) ----------
+    # Occupancy-adaptive hosted-delivery chunk schedule (split-round band
+    # only): the hosted column delivery picks its per-row chunk width from
+    # a ladder sized to the row's live emission count -- one narrow chunk
+    # for settled rows, few fat chunks for the dense burst rows whose
+    # per-chunk flat-scatter floors dominated the 100M build (chunking is
+    # trajectory-neutral: ascending ranges + rank continuation are
+    # bit-identical at ANY chunk, ops/mailbox.deliver).  "auto" = on.
+    overlay_adaptive_chunks: str = "auto"
+    # Dead-emission-row skip (split-round band only): the round pieces
+    # count each emission slot's entries AT EMISSION TIME (a scalar per
+    # processed slot) and the hosted delivery skips zero rows without the
+    # n-wide popcount each row otherwise costs -- once membership settles,
+    # ~16 of 17 rows are dead every round at 100M.  The same counts feed a
+    # scalar quiescence check, replacing the per-round (cap, n) emission-
+    # mask reductions.  Trajectory-neutral (the counts equal the masks'
+    # sums exactly; A/B-pinned).  "auto" = on.
+    overlay_dead_skip: str = "auto"
+    # One-shot static bootstrap (see OVERLAY_STATIC_BOOT_MIN_ROWS): "auto"
+    # size-bands (on at >= the band, off below -- bit-identical to round 6
+    # below it); "on"/"off" force either schedule at any n.  Changes the
+    # membership trajectory above the band (a deterministic re-choice of
+    # the bootstrap schedule, strictly CLOSER to the reference's burst);
+    # "off" reproduces the pre-round-7 staggered schedule exactly.
+    overlay_static_boot: str = "auto"
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
@@ -281,6 +326,23 @@ class Config:
                 and self.effective_time_mode != "ticks"):
             return "rounds"
         return "ticks" if self.n <= OVERLAY_TICKS_AUTO_MAX else "rounds"
+
+    @property
+    def overlay_adaptive_chunks_resolved(self) -> bool:
+        return self.overlay_adaptive_chunks != "off"
+
+    @property
+    def overlay_dead_skip_resolved(self) -> bool:
+        return self.overlay_dead_skip != "off"
+
+    def static_boot_for(self, n_rows: int) -> bool:
+        """One-shot static bootstrap for a ROUNDS-overlay surface of
+        `n_rows` rows (single-device engine only; the sharded hook path
+        keeps the per-round schedule -- its routed init has no burst
+        delivery and its per-shard slices sit below the band anyway)."""
+        if self.overlay_static_boot != "auto":
+            return self.overlay_static_boot == "on"
+        return n_rows >= OVERLAY_STATIC_BOOT_MIN_ROWS
 
     @property
     def compact_resolved(self) -> bool:
@@ -413,6 +475,11 @@ class Config:
         if self.telemetry not in ("on", "off"):
             raise ValueError(
                 f"telemetry must be on|off, got {self.telemetry!r}")
+        for name in ("overlay_adaptive_chunks", "overlay_dead_skip",
+                     "overlay_static_boot"):
+            v = getattr(self, name)
+            if v not in ("auto", "on", "off"):
+                raise ValueError(f"{name} must be auto|on|off, got {v!r}")
         if self.dup_suppress == "on" and self.crashrate_eff > 0.0:
             raise ValueError(
                 "-dup-suppress on requires an effective crash rate of 0 "
@@ -554,6 +621,25 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-overlay-mode", "--overlay-mode", dest="overlay_mode",
                    choices=("auto", "rounds", "ticks"),
                    default=d.overlay_mode)
+    p.add_argument("-overlay-adaptive-chunks", "--overlay-adaptive-chunks",
+                   dest="overlay_adaptive_chunks",
+                   choices=("auto", "on", "off"),
+                   default=d.overlay_adaptive_chunks,
+                   help="occupancy-adaptive hosted-delivery chunk ladder "
+                        "for the split-round overlay (trajectory-neutral; "
+                        "auto = on)")
+    p.add_argument("-overlay-dead-skip", "--overlay-dead-skip",
+                   dest="overlay_dead_skip", choices=("auto", "on", "off"),
+                   default=d.overlay_dead_skip,
+                   help="skip dead emission rows via counts carried across "
+                        "rounds (split-round overlay; trajectory-neutral; "
+                        "auto = on)")
+    p.add_argument("-overlay-static-boot", "--overlay-static-boot",
+                   dest="overlay_static_boot", choices=("auto", "on", "off"),
+                   default=d.overlay_static_boot,
+                   help="one-shot bootstrap burst for the rounds overlay "
+                        "(auto = on at >= 32M rows; off reproduces the "
+                        "staggered per-round schedule)")
     p.add_argument("-telemetry", "--telemetry", choices=("on", "off"),
                    default=d.telemetry,
                    help="device-resident per-window telemetry on fast-path "

@@ -114,14 +114,15 @@ def _run(cfg: Config, printer: ProgressPrinter,
                 and cfg.backend in ("jax", "sharded")
                 and cfg.effective_time_mode == "ticks"
                 and cfg.overlay_mode_resolved == "rounds"):
-            # The size-banded default (config.OVERLAY_TICKS_AUTO_MAX) uses
-            # the estimated clock above 1M nodes; say so once.  Gated on
-            # tick semantics: when -time-mode rounds forced the rounds
-            # overlay, recommending -overlay-mode ticks would point at a
-            # config validate() rejects.
+            # The size-banded default (config.OVERLAY_TICKS_AUTO_MAX,
+            # raised to 10M in round 7) uses the estimated clock above
+            # the band; say so once.  Gated on tick semantics: when
+            # -time-mode rounds forced the rounds overlay, recommending
+            # -overlay-mode ticks would point at a config validate()
+            # rejects.
             printer.note("overlay clock estimated as rounds x mean delay "
                          "at this n; -overlay-mode ticks gives per-message-"
-                         "faithful timing at 3-4x the cost")
+                         "faithful timing at ~2x the cost")
         max_overlay_windows = max(cfg.max_rounds, 1000)
         ckpt1 = _Checkpointer(cfg, stepper)
         # Same observability gate as the phase-2 fast path below: a quiet

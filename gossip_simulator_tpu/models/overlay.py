@@ -76,7 +76,58 @@ def spill_enabled(cap: int) -> bool:
     return cap < 16
 
 
-def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
+def _poisson_excess(lam: float, cap: int) -> float:
+    """E[(X - cap)+] for X ~ Poisson(lam): the expected per-node mailbox
+    overflow when a whole wave of uniform sends lands in ONE round.
+    Config-time host float; terms summed to a ~10-sigma tail."""
+    import math
+
+    p = math.exp(-lam)
+    e = 0.0
+    for k in range(1, int(lam + 10.0 * math.sqrt(max(lam, 1.0)) + 20)):
+        p = p * lam / k
+        if k > cap:
+            e += (k - cap) * p
+    return e
+
+
+def spill_cap_for(cfg: Config, n_rows: int) -> int:
+    """Spill capacity (pairs) for a single-device rounds surface of
+    `n_rows` rows; 0 = disabled (spill_enabled).  The static-bootstrap
+    band needs burst sizing: the one-shot n*fanout makeup burst lands in
+    ONE round, so in-degree is Poisson(fanout) all at once -- at cap 8 /
+    fanout 5 that is E[(X-8)+] ~ 0.122 overflow messages per node
+    (~12.2M pairs at 1e8, vs the 257 TOTAL the staggered schedule ever
+    overflowed), and the round-2 breakup reply wave is bounded by the
+    same lambda.  1.6x covers skew; the SPILL_CAP floor covers the
+    settled regime.  Spilled pairs are DELAYED one round, never lost --
+    the reference's cap-1024 channels absorb the same burst without
+    blocking, so the divergence is arrival order only (the documented
+    envelope)."""
+    cap = cfg.mailbox_cap_for(n_rows)
+    if not spill_enabled(cap):
+        return 0
+    if static_boot_applies(cfg, None):
+        return SPILL_CAP + int(1.6 * n_rows
+                               * _poisson_excess(float(cfg.fanout), cap))
+    return SPILL_CAP
+
+
+def static_boot_applies(cfg: Config, n_local: int | None,
+                        hooked: bool = False) -> bool:
+    """Whether the one-shot static bootstrap (config.overlay_static_boot)
+    runs on this surface: single-device rounds engine only (the sharded
+    hook path's routed init has no burst delivery and its per-shard
+    slices sit below the band), and the burst must fit the mailbox-cap
+    emission rows (fanout <= cap; always true for auto caps)."""
+    n = n_local if n_local is not None else cfg.n
+    return ((n_local is None and not hooked)
+            and cfg.static_boot_for(cfg.n)
+            and cfg.fanout <= cfg.mailbox_cap_for(n))
+
+
+def init_state(cfg: Config, n_local: int | None = None,
+               base_key: jax.Array | None = None) -> OverlayState:
     n = n_local if n_local is not None else cfg.n
     k = cfg.max_degree
     # Per-LOCAL-rows cap: one shard's slice keeps cap 16 far beyond the
@@ -91,12 +142,51 @@ def init_state(cfg: Config, n_local: int | None = None) -> OverlayState:
     # Non-spilling configs (spill_enabled) carry token-sized spill fields:
     # the buffers are loop-invariant pass-throughs there, but full-size
     # ones still measurably regressed the bounded phase-1 while_loop
-    # (+4.7 s on the 27-round 10M build).
-    sc = SPILL_CAP if spill_enabled(cap) else 0
+    # (+4.7 s on the 27-round 10M build).  The static-boot band sizes for
+    # the one-shot burst's concentrated overflow (spill_cap_for); the
+    # sharded hook path (n_local given) never spills and keeps the flat
+    # floor so its replicated token fields stay small.
+    sc = (spill_cap_for(cfg, n) if n_local is None
+          else (SPILL_CAP if spill_enabled(cap) else 0))
+    if static_boot_applies(cfg, n_local):
+        if base_key is None:
+            # make_round_fn skips the per-round bootstrap under the same
+            # gate; silently building a burst-less state here would leave
+            # the overlay with no bootstrap at all.
+            raise ValueError(
+                "overlay.init_state: static bootstrap requires base_key")
+        # One-shot static bootstrap (round 7; config.overlay_static_boot):
+        # draw the whole initial friends table and stage the n*fanout
+        # makeup burst as the first `fanout` emission rows, exactly the
+        # way overlay_ticks.init_state always has -- the reference's
+        # needNewFriend loop re-arms with no delay (simulator.go:103-105),
+        # so every node fills all fanout slots at t~0, and once at fanout
+        # it can never drop below it (breakup at/under fanout replaces in
+        # place; removal only happens above) -- the per-round bootstrap
+        # never fires again, and make_round_fn skips it entirely.  Draws
+        # are per-LANE keyed (one (n,) column at a time; no (n, fanout)
+        # matrix to tile-pad at 1e8), self patched (id+1)%N like every
+        # bootstrap draw (simulator.go:97-100).
+        f = cfg.fanout
+        ids = jnp.arange(n, dtype=I32)
+        kb = _rng.tick_key(base_key, 0, _rng.OP_BOOTSTRAP)
+        friends = jnp.full((n, k), -1, I32)
+        mk_dst = jnp.full((cap, n), -1, I32)
+        colsel = jnp.arange(k, dtype=I32)[None, :]
+        for j in range(f):
+            wj = _rng.row_randint(kb, n, ids * f + j, 1)[:, 0]
+            wj = jnp.where(wj == ids, (wj + 1) % n, wj)
+            friends = jnp.where(colsel == j, wj[:, None], friends)
+            mk_dst = mk_dst.at[j].set(wj)
+        cnt = jnp.full((n,), f, I32)
+    else:
+        friends = jnp.full((n, k), -1, I32)
+        cnt = jnp.zeros((n,), I32)
+        mk_dst = jnp.full((cap, n), -1, I32)
     return OverlayState(
-        friends=jnp.full((n, k), -1, I32),
-        friend_cnt=jnp.zeros((n,), I32),
-        mk_dst=jnp.full((cap, n), -1, I32),
+        friends=friends,
+        friend_cnt=cnt,
+        mk_dst=mk_dst,
         bk_dst=jnp.full((cap, n), -1, I32),
         boot_dst=jnp.full((n,), -1, I32),
         mk_spill=jnp.full((2, sc + 1), -1, I32),
@@ -125,6 +215,37 @@ def delivery_chunk(cfg: Config, n_rows: int) -> int:
     if cfg.compact_chunk > 0:
         return cfg.compact_chunk
     return min(n_rows, max(65_536, n_rows // 128), 1_048_576)
+
+
+# Fattest rung of the adaptive hosted-chunk ladder (hosted_chunk_widths):
+# dense burst rows at n=1e8 drop from 128 base-width chunks to 12, each
+# chunk's flat scatter/sort paying its fixed cost once -- the scatter into
+# the 3.2 GB rank-major mailbox is ~flat per op at GB-scale targets
+# (README device-span finding; scripts/profile_overlay.py measures the
+# per-width constants), so fewer, fatter chunks win on dense rows exactly
+# as they did for the ticks drain (ticks_delivery_chunk).  Bounded so one
+# chunk's sort stays well under the watchdog and its operand pair is
+# ~64 MB.  Module-level so tests can lower it.
+ADAPTIVE_CHUNK_MAX = 8_388_608
+
+
+def hosted_chunk_widths(cfg: Config, n_rows: int) -> tuple[int, ...]:
+    """Occupancy-adaptive chunk-width ladder for the hosted (split-round)
+    delivery: geometric x4 rungs from the swept base width
+    (delivery_chunk) up to ADAPTIVE_CHUNK_MAX.  Each row picks the
+    narrowest rung covering its live count in one chunk -- settled rows
+    keep the swept narrow optimum, burst rows take the fat rungs.  Chunk
+    width never changes results (deliver's compact_chunk contract), so
+    the gate (config.overlay_adaptive_chunks) is pure perf; "off" pins
+    the single pre-round-7 width."""
+    base = delivery_chunk(cfg, n_rows)
+    if not cfg.overlay_adaptive_chunks_resolved:
+        return (base,)
+    hi = max(base, min(n_rows, ADAPTIVE_CHUNK_MAX))
+    widths = [base]
+    while widths[-1] < hi:
+        widths.append(min(widths[-1] * 4, hi))
+    return tuple(widths)
 
 
 def _col_onehot(cols, k: int):
@@ -228,6 +349,11 @@ def make_round_fn(cfg: Config,
     k = cfg.max_degree
     fanout, fanin = cfg.fanout, cfg.fanin_resolved
     cap = cfg.mailbox_cap_for(n_rows if n_rows is not None else n)
+    # One-shot bootstrap (round 7): init_state staged the burst, so the
+    # per-round bootstrap block is skipped -- must agree with init_state's
+    # gate or the overlay would never bootstrap at all.
+    static_boot = static_boot_applies(cfg, n_rows,
+                                      hooked=deliver_fn is not None)
     # Mailboxes come back either 2-D (n, cap) or FLAT rank-major
     # (cap*n + 1; slot r contiguous at [r*n, (r+1)*n)) -- the large-n
     # path never materializes the (n, cap) shape, whose narrow minor dim
@@ -241,6 +367,7 @@ def make_round_fn(cfg: Config,
         from gossip_simulator_tpu.ops.mailbox import (deliver_columns,
                                                       flat_addressing_fits)
 
+        sc_band = spill_cap_for(cfg, n)
         if n > COLUMN_DELIVERY_MIN_ROWS and flat_addressing_fits(n, cap):
             # Per-SLOT delivery: same entries at ~1/slots the compaction
             # scan width (deliver_columns' rationale; the flattened form
@@ -264,11 +391,11 @@ def make_round_fn(cfg: Config,
                     carry = (_dep_full((n * cap + 1,), -1, dep),
                              _dep_full((n + 1,), 0, dep),
                              jnp.zeros((), I32))
-                if not spill_enabled(cap):
+                if sc_band == 0:
                     out = deliver_columns(mats, n, cap, dchunk, flat=True,
                                           carry=carry)
                     return out + (None,)
-                acc = (jnp.full((2, SPILL_CAP + 1), -1, I32),
+                acc = (jnp.full((2, sc_band + 1), -1, I32),
                        jnp.zeros((), I32))
                 mbox, load, dropped, (pairs, _) = deliver_columns(
                     mats, n, cap, dchunk, flat=True, carry=carry,
@@ -338,15 +465,20 @@ def make_round_fn(cfg: Config,
 
     def p_bk_process(friends, cnt, bk_mbox, n_bk, drop2, round_, base_key):
         """Process the breakup mailbox (simulator.go:76-94), emitting
-        replacement makeups into mk_em."""
+        replacement makeups into mk_em.  Also returns mk_cnt int32[cap]:
+        each emission slot's entry count, recorded AT WRITE TIME (one
+        scalar reduction per processed slot) -- the round-7 dead-row mask
+        the hosted delivery consumes next round instead of popcounting
+        every n-wide row (dead in the fused round; XLA drops it)."""
         ids = ids_fn()  # GLOBAL ids of local rows (identity comparisons)
         rkey = jax.random.fold_in(base_key, round_)
         # mk_em allocates after the bk delivery (see _dep_full).
         mk_em = _dep_full((cap, ids.shape[0]), -1, drop2)
         win_bk = jnp.zeros((), I32)
+        mk_cnt = jnp.zeros((cap,), I32)
 
         def bk_body(slot, carry):
-            friends, cnt, mk_em, win_bk = carry
+            friends, cnt, mk_em, win_bk, mk_cnt = carry
             src = _slot(bk_mbox, slot)
             has = src >= 0
             kk = jax.random.fold_in(
@@ -354,7 +486,8 @@ def make_round_fn(cfg: Config,
             friends, cnt, nf, rp = process_breakup_slot(
                 n, fanout, friends, cnt, src, has, ids, kk)
             mk_em = mk_em.at[slot].set(jnp.where(rp, nf, -1))
-            return friends, cnt, mk_em, win_bk + has.sum(dtype=I32)
+            mk_cnt = mk_cnt.at[slot].set(rp.sum(dtype=I32))
+            return friends, cnt, mk_em, win_bk + has.sum(dtype=I32), mk_cnt
 
         # Slot loops run to the MAX mailbox load this round (n_mk/n_bk from
         # the delivery), not the fixed capacity: slots are rank-contiguous,
@@ -363,7 +496,7 @@ def make_round_fn(cfg: Config,
         # trip counts are fine under shard_map: the bodies contain no
         # collectives.
         return jax.lax.fori_loop(
-            0, n_bk, bk_body, (friends, cnt, mk_em, win_bk))
+            0, n_bk, bk_body, (friends, cnt, mk_em, win_bk, mk_cnt))
 
     def p_mk_deliver(mk_dst, boot_dst, mk_spill, friends, cnt, win_bk):
         """Deliver the MAKEUP emissions (the breakup mailbox is dead by
@@ -384,9 +517,16 @@ def make_round_fn(cfg: Config,
     def p_mk_process(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em,
                      win_bk, round_, makeups0, breakups0, dropped0,
                      base_key, mk_sp=None, bk_sp=None,
-                     spill0=None) -> OverlayState:
+                     spill0=None, mk_cnt=None, aux=False):
         """Process the makeup mailbox (simulator.go:66-75), bootstrap
-        (simulator.go:95-106) and assemble the next state."""
+        (simulator.go:95-106) and assemble the next state.
+
+        With `aux` (the split round), also returns the round-7 dead-row
+        bookkeeping: (mk_cnt, bk_cnt, boot_cnt, quiesced) -- per-slot
+        emission counts recorded at write time (exactly the sums
+        pending_emissions would reduce out of the (cap, n) buffers) plus
+        the quiescence flag computed from them, so the split loop's
+        per-round eager quiesced() never touches the multi-GB masks."""
         ids = ids_fn()
         n_local = ids.shape[0]
         rows = jnp.arange(n_local, dtype=I32)  # LOCAL row indices
@@ -394,9 +534,10 @@ def make_round_fn(cfg: Config,
         bk_em = _dep_full((cap, n_local), -1, win_bk)
         dropped = dropped0 + sum_fn(drop1 + drop2)
         win_mk = jnp.zeros((), I32)
+        bk_cnt = jnp.zeros((cap,), I32)
 
         def mk_body(slot, carry):
-            friends, cnt, bk_em, win_mk = carry
+            friends, cnt, bk_em, win_mk, bk_cnt = carry
             src = _slot(mk_mbox, slot)
             has = src >= 0
             kk = jax.random.fold_in(
@@ -404,20 +545,33 @@ def make_round_fn(cfg: Config,
             friends, cnt, victim, ev = process_makeup_slot(
                 fanin, friends, cnt, src, has, kk)
             bk_em = bk_em.at[slot].set(jnp.where(ev, victim, -1))
-            return friends, cnt, bk_em, win_mk + has.sum(dtype=I32)
+            bk_cnt = bk_cnt.at[slot].set(ev.sum(dtype=I32))
+            return friends, cnt, bk_em, win_mk + has.sum(dtype=I32), bk_cnt
 
-        friends, cnt, bk_em, win_mk = jax.lax.fori_loop(
-            0, n_mk, mk_body, (friends, cnt, bk_em, win_mk))
+        friends, cnt, bk_em, win_mk, bk_cnt = jax.lax.fori_loop(
+            0, n_mk, mk_body, (friends, cnt, bk_em, win_mk, bk_cnt))
 
-        # --- bootstrap: one friend per round while under fanout ------------
-        kb = jax.random.fold_in(rkey, _rng.OP_BOOTSTRAP)
-        under = cnt < fanout
-        w = jax.random.randint(kb, (n_local,), 0, n, dtype=I32)
-        w = jnp.where(w == ids, (w + 1) % n, w)
-        appcol = jnp.minimum(cnt, k - 1)
-        friends = _masked_set(friends, rows, appcol, w, under)
-        cnt = cnt + under.astype(I32)
-        boot_em = jnp.where(under, w, -1)
+        if static_boot:
+            # One-shot bootstrap at init (init_state's burst): cnt >=
+            # fanout is invariant from round 0 -- breakup at/under fanout
+            # replaces in place and removal only happens above it -- so
+            # the per-round `under` mask is all-False forever and the
+            # whole draw/append/emit block is dead weight (an n-wide
+            # randint + 4 elementwise passes per round at 1e8).  Skipping
+            # it is EXACTLY identical, not just statistically.
+            boot_em = jnp.full((n_local,), -1, I32)
+            boot_cnt = jnp.zeros((), I32)
+        else:
+            # --- bootstrap: one friend per round while under fanout --------
+            kb = jax.random.fold_in(rkey, _rng.OP_BOOTSTRAP)
+            under = cnt < fanout
+            w = jax.random.randint(kb, (n_local,), 0, n, dtype=I32)
+            w = jnp.where(w == ids, (w + 1) % n, w)
+            appcol = jnp.minimum(cnt, k - 1)
+            friends = _masked_set(friends, rows, appcol, w, under)
+            cnt = cnt + under.astype(I32)
+            boot_em = jnp.where(under, w, -1)
+            boot_cnt = under.sum(dtype=I32)
 
         # Global reductions (psum when sharded): window counts feed both the
         # progress lines and the quiescence predicate, so they must be the
@@ -429,7 +583,7 @@ def make_round_fn(cfg: Config,
         # them as an (mk, bk) tuple.
         mk_spill = mk_sp if mk_sp is not None else spill0[0]
         bk_spill = bk_sp if bk_sp is not None else spill0[1]
-        return OverlayState(
+        st = OverlayState(
             friends=friends, friend_cnt=cnt, mk_dst=mk_em, bk_dst=bk_em,
             boot_dst=boot_em, mk_spill=mk_spill, bk_spill=bk_spill,
             round=round_ + 1,
@@ -437,10 +591,23 @@ def make_round_fn(cfg: Config,
             win_makeups=win_mk, win_breakups=win_bk,
             mailbox_dropped=dropped,
         )
+        if not aux:
+            return st
+        # Counts == the emission-mask sums by construction (every slot row
+        # is where(mask, value>=0, -1), so entries == mask trues; rows past
+        # the trip count keep their zero), making this EXACTLY
+        # overlay.quiesced(st) without the (cap, n) reductions.
+        mk_sp_live = (mk_spill[1] >= 0).sum(dtype=I32)
+        bk_sp_live = (bk_spill[1] >= 0).sum(dtype=I32)
+        pending = (mk_cnt.sum(dtype=I32) + bk_cnt.sum(dtype=I32)
+                   + boot_cnt + mk_sp_live + bk_sp_live)
+        q = ((win_mk == 0) & (win_bk == 0) & (pending == 0)
+             & (st.round > 0))
+        return st, (mk_cnt, bk_cnt, boot_cnt, mk_sp_live, bk_sp_live, q)
 
     def round_fn(st: OverlayState, base_key: jax.Array) -> OverlayState:
         bk_mbox, n_bk, drop2, bk_sp = p_bk_deliver(st.bk_dst, st.bk_spill)
-        friends, cnt, mk_em, win_bk = p_bk_process(
+        friends, cnt, mk_em, win_bk, _mk_cnt = p_bk_process(
             st.friends, st.friend_cnt, bk_mbox, n_bk, drop2, st.round,
             base_key)
         mk_mbox, n_mk, drop1, friends, cnt, mk_sp = p_mk_deliver(
@@ -485,9 +652,10 @@ def make_split_round_fn(cfg: Config):
     _, p_bk_process, _, p_mk_process = fused.pieces
     n = cfg.n
     cap = cfg.mailbox_cap_for(n)
+    dead_skip = cfg.overlay_dead_skip_resolved
+    sc_split = spill_cap_for(cfg, n)
     hosted_deliver = make_hosted_column_delivery(
-        n, cap, delivery_chunk(cfg, n),
-        spill_cap=SPILL_CAP if spill_enabled(cap) else 0)
+        n, cap, hosted_chunk_widths(cfg, n), spill_cap=sc_split)
 
     # bk_mbox is not donated for the same reason as b2_fn's mk_mbox (no
     # same-shaped output to alias; liveness frees it after the slot loop).
@@ -501,13 +669,15 @@ def make_split_round_fn(cfg: Config):
     # "donated buffers were not usable" warning -- at n=1e8 it is freed
     # by liveness right after the slot loop either way); friends/cnt/
     # mk_em/spills all alias same-shaped state outputs.
-    @functools.partial(jax.jit, donate_argnums=(4, 5, 6, 13, 14))
+    @functools.partial(jax.jit, donate_argnums=(4, 5, 6, 13, 14),
+                       static_argnums=(16,))
     def b2_fn(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em, win_bk,
               round_, makeups0, breakups0, dropped0, base_key, mk_sp,
-              bk_sp):
+              bk_sp, mk_cnt, aux):
         return p_mk_process(mk_mbox, n_mk, drop1, drop2, friends, cnt,
                             mk_em, win_bk, round_, makeups0, breakups0,
-                            dropped0, base_key, mk_sp=mk_sp, bk_sp=bk_sp)
+                            dropped0, base_key, mk_sp=mk_sp, bk_sp=bk_sp,
+                            mk_cnt=mk_cnt, aux=aux)
 
     fence_jit = jax.jit(lambda x: x + 1)
     reshape_boot = jax.jit(lambda b: b[None, :])
@@ -522,6 +692,16 @@ def make_split_round_fn(cfg: Config):
         + scalar transfer per phase, noise against seconds of device
         work at split scale."""
         jax.device_get(fence_jit(jnp.int32(1)))
+
+    # Round-7 dead-row bookkeeping carried ACROSS rounds on the host: the
+    # totals describe the state's emission buffers (counted at write time
+    # inside b2/a2), so round r's deliveries skip last round's dead rows
+    # without popcounting them, and the quiescence flag arrives as one
+    # scalar instead of an eager multi-GB mask reduction.  None until the
+    # first full round (and after a checkpoint restore, which builds a
+    # fresh round fn): those rounds pay the popcount fallback once.
+    carry = {"mk": None, "bk": None, "boot": None, "round": None,
+             "mk_sp": None, "bk_sp": None}
 
     def round4(st: OverlayState | list, base_key) -> OverlayState:
         # Drop every dead reference before the next call: buffers whose
@@ -540,35 +720,69 @@ def make_split_round_fn(cfg: Config):
         round_, mk0, bk0, d0 = (st.round, st.makeups, st.breakups,
                                 st.mailbox_dropped)
         del st
-        if spill_enabled(cap):
+        if dead_skip and carry["mk"] is not None and (
+                carry["round"] != int(jax.device_get(round_))):
+            # The incoming state is not the one the totals describe (a
+            # restored snapshot fed to a live round fn): stale totals
+            # would silently skip live rows -- fall back to popcounts.
+            carry["mk"] = carry["bk"] = carry["boot"] = None
+        known = dead_skip and carry["mk"] is not None
+        bk_totals = carry["bk"] if dead_skip else None
+        mk_totals = carry["mk"] + [carry["boot"]] if known else None
+        if sc_split > 0:
+            # An empty spill's re-delivery is a no-op that still pays one
+            # full-spill-width sort (at the static-boot band the buffer
+            # is burst-sized, ~19M pairs at 1e8) -- skip it when the
+            # carried EXACT live count says there is nothing in flight.
+            bk_spin = None if (known and carry["bk_sp"] == 0) else bk_spill0
             bk_mbox, n_bk, drop2, bk_sp = hosted_deliver(
-                (bk_dst,), spill_in=bk_spill0)
+                (bk_dst,), spill_in=bk_spin, row_totals=bk_totals)
         else:
-            bk_mbox, n_bk, drop2 = hosted_deliver((bk_dst,))
+            bk_mbox, n_bk, drop2 = hosted_deliver((bk_dst,),
+                                                  row_totals=bk_totals)
             bk_sp = bk_spill0  # always-empty pass-through
         del bk_dst, bk_spill0
         fence()
-        friends, cnt, mk_em, win_bk = a2_fn(friends, cnt, bk_mbox, n_bk,
-                                            drop2, round_, base_key)
+        friends, cnt, mk_em, win_bk, mk_cnt = a2_fn(
+            friends, cnt, bk_mbox, n_bk, drop2, round_, base_key)
         del bk_mbox
         jax.block_until_ready(friends)
         fence()
-        if spill_enabled(cap):
+        if sc_split > 0:
+            mk_spin = None if (known and carry["mk_sp"] == 0) else mk_spill0
             mk_mbox, n_mk, drop1, mk_sp = hosted_deliver(
-                (mk_dst, reshape_boot(boot_dst)), spill_in=mk_spill0)
+                (mk_dst, reshape_boot(boot_dst)), spill_in=mk_spin,
+                row_totals=mk_totals)
         else:
             mk_mbox, n_mk, drop1 = hosted_deliver(
-                (mk_dst, reshape_boot(boot_dst)))
+                (mk_dst, reshape_boot(boot_dst)), row_totals=mk_totals)
             mk_sp = mk_spill0
         del mk_dst, boot_dst, mk_spill0
         fence()
         out = b2_fn(mk_mbox, n_mk, drop1, drop2, friends, cnt, mk_em,
-                    win_bk, round_, mk0, bk0, d0, base_key, mk_sp, bk_sp)
-        del mk_mbox, friends, cnt, mk_em, mk_sp, bk_sp
-        jax.block_until_ready(out.friends)
+                    win_bk, round_, mk0, bk0, d0, base_key, mk_sp, bk_sp,
+                    mk_cnt, dead_skip)
+        del mk_mbox, friends, cnt, mk_em, mk_sp, bk_sp, mk_cnt
+        if dead_skip:
+            out, (a_mk, a_bk, a_boot, a_msp, a_bsp, q) = out
+            jax.block_until_ready(out.friends)
+            # One small transfer per round (cap-sized vectors + scalars),
+            # riding the sync the split already pays.
+            a_mk, a_bk, a_boot, a_msp, a_bsp, q, rnd = jax.device_get(
+                (a_mk, a_bk, a_boot, a_msp, a_bsp, q, out.round))
+            carry["mk"] = [int(v) for v in a_mk]
+            carry["bk"] = [int(v) for v in a_bk]
+            carry["boot"] = int(a_boot)
+            carry["mk_sp"] = int(a_msp)
+            carry["bk_sp"] = int(a_bsp)
+            carry["round"] = int(rnd)
+            round4.last_quiesced = bool(q)
+        else:
+            jax.block_until_ready(out.friends)
         fence()
         return out
 
+    round4.last_quiesced = None
     return round4
 
 

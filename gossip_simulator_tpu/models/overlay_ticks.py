@@ -45,7 +45,8 @@ import jax.numpy as jnp
 
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models.overlay import (process_breakup_slot,
-                                                 process_makeup_slot)
+                                                 process_makeup_slot,
+                                                 spill_enabled)
 from gossip_simulator_tpu.ops.mailbox import deliver_pair
 from gossip_simulator_tpu.ops.select import first_true_indices
 from gossip_simulator_tpu.utils import rng as _rng
@@ -54,6 +55,39 @@ I32 = jnp.int32
 
 MK = 0  # payload type bits: makeup
 BK = 1  # breakup
+
+# Mailbox-overflow spill capacity for THIS engine's cap-8 memory band
+# (round 7, VERDICT r5 #4 -- the last counted-drop surface): overflowed
+# (pay, typ*n+dst) pairs re-deliver FIRST next window instead of dropping,
+# the reference's channel-full backpressure (senders block; membership
+# traffic is delayed, never lost -- simulator.go:51-54).  Sizing rationale
+# matches overlay.SPILL_CAP (the rounds engine observed 257 overflow
+# messages TOTAL at 1e8/cap 8); past the spill cap messages still fall
+# through to counted drops.  Module-level so tests can zero it (the
+# control run of the spill suite).
+SPILL_CAP = 65_536
+
+# Prefix-dense drain delivery (round 7): after the drain's stable toff
+# sort, the live entries are a packed PREFIX of known length (the ring
+# count), so the chunked delivery runs plain ascending ranges with no
+# per-chunk compaction scans (ops.mailbox deliver_pair prefix_len) --
+# bit-identical, and the scans were the dominant term of the 10M chunk
+# sweep (the justification for raising config.OVERLAY_TICKS_AUTO_MAX to
+# 10M).  Module-level so the A/B test can pin prefix == masked.
+PREFIX_DRAIN = True
+
+
+def ticks_spill_cap(cfg: Config, n_rows: int | None = None) -> int:
+    """Spill capacity for a single-device ticks surface (0 = disabled):
+    engages exactly where drops were ever possible -- the slot-major
+    memory band's shrunken stacked mailbox cap (spill_enabled mirrors the
+    rounds engine: cap 16 overflow needs in-degree > 16 in one window,
+    never observed, and threading the accumulator costs real op floors).
+    The sharded hook path keeps counted drops (its routed delivery has no
+    spill, like the sharded rounds overlay)."""
+    n = n_rows if n_rows is not None else cfg.n
+    cap_mb = cfg.mailbox_cap_for(n, stacked=True)
+    return SPILL_CAP if (slotmajor(n) and spill_enabled(cap_mb)) else 0
 
 
 # Narrowest occupancy-adaptive drain width (make_step_fn): windows with
@@ -155,6 +189,11 @@ class OverlayTickState(NamedTuple):
     ring_dst: jnp.ndarray  # int32[dw*cap + 1]
     ring_pay: jnp.ndarray  # int32[dw*cap + 1]  (src*2 + type)*b + toff
     ring_cnt: jnp.ndarray  # int32[1, dw]
+    # Mailbox-overflow spill pairs (pay, typ*n + dst), -1-padded key row;
+    # re-delivered first next window (ticks_spill_cap; token (2, 1) where
+    # the spill is disabled).  In-flight messages: quiescence requires an
+    # empty spill.
+    spill: jnp.ndarray  # int32[2, sc + 1]
     tick: jnp.ndarray  # int32[]  window-aligned simulated ms
     makeups: jnp.ndarray  # int32[]  cumulative processed (MakeUps)
     breakups: jnp.ndarray  # int32[]
@@ -216,6 +255,7 @@ def init_state(cfg: Config, base_key: jax.Array) -> OverlayTickState:
     st = OverlayTickState(
         friends=friends, friend_cnt=cnt,
         ring_dst=ring_dst, ring_pay=ring_pay, ring_cnt=ring_cnt,
+        spill=jnp.full((2, ticks_spill_cap(cfg) + 1), -1, I32),
         tick=z(), makeups=z(), breakups=z(),
         win_makeups=z(), win_breakups=z(), mailbox_dropped=z())
     # The burst: n*f makeups at t=0, each with its own delay.  Appended in
@@ -368,14 +408,34 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
             return _emit_all(cfg, ring, base_key, w, em_dst, em_toff,
                              typ, op)
 
-    def _deliver_both(src_pay, dst, typ, evalid):
+    # Spill engages exactly where drops were ever possible (the slot-major
+    # band's cap-8 stacked mailbox; `sm` is false on the sharded hook
+    # path); everywhere else the token (2, 1) buffer passes through
+    # untouched.
+    sc = ticks_spill_cap(cfg, n_rows) if sm else 0
+    prefix = PREFIX_DRAIN
+
+    def _deliver_both(src_pay, dst, typ, evalid, m_live, spill_in):
         # Both message types in ONE sorted pass (ops.mailbox.deliver_pair;
         # bit-identical to two deliver() calls at ~half the op count).
         # Memory band: rank-major flat stacked buffer + per-type loads.
+        # The drain sorts live entries into a packed prefix of length
+        # `m_live`, so the chunked path skips its compaction scans
+        # (prefix_len; PREFIX_DRAIN pins the A/B).  At the spill band the
+        # last window's overflow pairs re-deliver first and this window's
+        # overflow accumulates instead of dropping.
+        plen = m_live if prefix else None
+        if sc > 0:
+            acc = (jnp.full((2, sc + 1), -1, I32), jnp.zeros((), I32))
+            return deliver_pair(src_pay, dst, typ, evalid, n_rows, cap_mb,
+                                compact_chunk=dchunk, flat=sm,
+                                prefix_len=plen, spill_in=spill_in,
+                                spill=acc)
         return deliver_pair(src_pay, dst, typ, evalid, n_rows, cap_mb,
-                            compact_chunk=dchunk, flat=sm)
+                            compact_chunk=dchunk, flat=sm,
+                            prefix_len=plen) + (None,)
 
-    def _drain_at_width(width, ring_dst, ring_pay, slot, m):
+    def _drain_at_width(width, ring_dst, ring_pay, slot, m, spill_in):
         """Drain one window slot through a `width`-lane sort + delivery.
         Entries are rank-packed at [slot*cap, slot*cap + m), so any
         width >= m sees the whole live prefix; lanes past m hold stale
@@ -391,7 +451,7 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         evalid = toff_key < b
         typ = (pay_e // b) % 2
         mbox_pay = (pay_e // (2 * b)) * b + pay_e % b  # src*b + toff
-        return _deliver_both(mbox_pay, dst_e, typ, evalid)
+        return _deliver_both(mbox_pay, dst_e, typ, evalid, m, spill_in)
 
     # Occupancy-adaptive drain widths (VERDICT r3 #5): slot_cap budgets
     # the worst-case window -- a 100M-lane 4-operand sort at 10M nodes --
@@ -407,23 +467,24 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         w = st.tick // b
         slot = w % dw
         m = st.ring_cnt[0, slot]
+        spill_in = st.spill if sc > 0 else None
         if len(widths) == 1:
             drained = _drain_at_width(cap, st.ring_dst, st.ring_pay, slot,
-                                      m)
+                                      m, spill_in)
         else:
             # widths descend; ws[0] = cap >= m always, so the last
             # fitting index is count_of_fits - 1.
             sel = (jnp.asarray(widths, dtype=I32) >= m).sum(dtype=I32) - 1
             drained = jax.lax.switch(
                 sel,
-                [lambda rd, rp, sl, mm, w_=w_: _drain_at_width(w_, rd, rp,
-                                                               sl, mm)
+                [lambda rd, rp, sl, mm, w_=w_: _drain_at_width(
+                    w_, rd, rp, sl, mm, spill_in)
                  for w_ in widths],
                 st.ring_dst, st.ring_pay, slot, m)
         if sm:
             # Rank-major flat stacked mailbox: slot r of type t is the
             # contiguous range [r*2n + t*n, r*2n + (t+1)*n).
-            pair_mbox, n_mk, n_bk, local_drops = drained
+            pair_mbox, n_mk, n_bk, local_drops, spill_out = drained
 
             def mk_slot(sl):
                 return jax.lax.dynamic_slice(pair_mbox,
@@ -433,11 +494,12 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
                 return jax.lax.dynamic_slice(
                     pair_mbox, (sl * 2 * n_rows + n_rows,), (n_rows,))
         else:
-            mk_mbox, bk_mbox, local_drops = drained
+            mk_mbox, bk_mbox, local_drops, spill_out = drained
             n_bk = (bk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
             n_mk = (mk_mbox >= 0).sum(axis=1, dtype=I32).max(initial=0)
             mk_slot = lambda sl: mk_mbox[:, sl]
             bk_slot = lambda sl: bk_mbox[:, sl]
+        spill = spill_out[0] if spill_out is not None else st.spill
         ring_cnt = st.ring_cnt.at[0, slot].set(0)
 
         rkey = key_fn(base_key, w, _rng.OP_REPLACE)
@@ -521,6 +583,7 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         return OverlayTickState(
             friends=friends, friend_cnt=cnt,
             ring_dst=ring_dst, ring_pay=ring_pay, ring_cnt=ring_cnt,
+            spill=spill,
             tick=st.tick + b,
             makeups=st.makeups + win_mk, breakups=st.breakups + win_bk,
             win_makeups=st.win_makeups + win_mk,
@@ -561,9 +624,12 @@ def make_poll_fn(cfg: Config):
 
 
 def quiesced(st: OverlayTickState) -> jnp.ndarray:
-    """A full poll window with zero processed messages AND an empty ring."""
+    """A full poll window with zero processed messages AND an empty ring
+    (spilled overflow pairs are in-flight messages: quiescing on them
+    would lose them)."""
     return ((st.win_makeups == 0) & (st.win_breakups == 0)
-            & ~jnp.any(st.ring_cnt > 0) & (st.tick > 0))
+            & ~jnp.any(st.ring_cnt > 0) & ~jnp.any(st.spill[1] >= 0)
+            & (st.tick > 0))
 
 
 def run_call_budget(cfg: Config, shards: int = 1) -> int:

@@ -180,8 +180,51 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
     return mbox, count, dropped
 
 
+def _deliver_prefix_keyed(src, key_full, live, nk, cap, chunk,
+                          carry=None, rank_major=False, spill=None):
+    """Chunked delivery of a prepacked-key stream whose valid entries are a
+    known-length PREFIX (`live`, an int32 scalar): chunks are plain
+    ascending index ranges with NO per-chunk compaction scan --
+    first_true_indices of a prefix mask IS the ascending range, so this is
+    bit-identical to _deliver_compact_keyed on that mask (lanes at or past
+    `live` carry the caller's nk sentinel and land in the trash cell
+    either way) at zero scan cost.  The ticks overlay's drain is the
+    consumer: its stable toff sort packs every live entry into a prefix of
+    known length (the ring count), and the per-chunk scans were the
+    dominant term of the 10M delivery sweep (ticks_delivery_chunk's 64k
+    3.40 -> 2M 2.18 s/window gradient was scan amortization).  Returns
+    like _deliver_compact_keyed."""
+    chunks = (live + chunk - 1) // chunk
+
+    def body(i, bcarry):
+        if spill is not None:
+            mbox, count, dropped, pairs, scnt = bcarry
+        else:
+            mbox, count, dropped = bcarry
+        idx = i * chunk + jnp.arange(chunk, dtype=jnp.int32)
+        s = src.at[idx].get(mode="fill", fill_value=-1)
+        key = key_full.at[idx].get(mode="fill", fill_value=nk)
+        if spill is not None:
+            mbox, count, dropped, (pairs, scnt) = _compact_chunk_step(
+                mbox, count, dropped, key, s, nk, cap, rank_major,
+                spill=(pairs, scnt))
+            return mbox, count, dropped, pairs, scnt
+        return _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
+                                   rank_major)
+
+    if carry is None:
+        carry = (jnp.full((nk * cap + 1,), -1, dtype=jnp.int32),
+                 jnp.zeros((nk + 1,), dtype=jnp.int32),
+                 jnp.zeros((), jnp.int32))
+    if spill is not None:
+        out = jax.lax.fori_loop(0, chunks, body, carry + spill)
+        return out[0], out[1], out[2], (out[3], out[4])
+    return jax.lax.fori_loop(0, chunks, body, carry)
+
+
 def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
-                 compact_chunk: int | None = None, flat: bool = False):
+                 compact_chunk: int | None = None, flat: bool = False,
+                 prefix_len=None, spill_in=None, spill=None):
     """Deliver a two-TYPE message stream into two mailbox sets in ONE
     sorted pass: key (typ, dst) packed as typ*n + dst, shared compaction,
     one stable sort, one scatter into a stacked [2n, cap] buffer split
@@ -202,21 +245,74 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
     buffer instead: (mbox int32[2n*cap + 1], load_t0, load_t1, dropped),
     where mailbox slot r of type t is the CONTIGUOUS range
     [r*2n + t*n, r*2n + (t+1)*n) and load_t* are the max per-node counts
-    (clamped to cap).  Cell contents are identical to the 2-D form."""
+    (clamped to cap).  Cell contents are identical to the 2-D form.
+
+    `prefix_len` (int32 scalar) asserts the valid entries are a packed
+    prefix of that length (the ticks drain's post-sort layout): the
+    chunked path then runs plain ascending ranges with no compaction
+    scans (_deliver_prefix_keyed; bit-identical to the masked form).
+
+    `spill_in` / `spill` mirror deliver_columns' overflow spill (round 7,
+    the ticks overlay's lossless-membership band): `spill_in` is a
+    (2, S(+1)) (pay, packed-key) pair list re-delivered FIRST through the
+    same carry (delayed messages arrive before this window's); `spill` is
+    a (pairs, cnt) accumulator collecting THIS delivery's capacity
+    overflow as (pay, typ*n + dst) pairs instead of dropping -- the
+    return gains the final pairs array.  Requires the chunked path (the
+    single-pass branch routes through it with one full-width chunk)."""
     m = src.shape[0]
     n2 = 2 * n
     if not flat_addressing_fits(2 * n + 1, cap):
         assert not flat, "flat deliver_pair requires stacked addressing"
+        assert spill is None and spill_in is None, \
+            "deliver_pair spill requires stacked flat addressing"
         m0, _, d0 = deliver(src, dst, evalid & (typ == 0), n, cap,
                             compact_chunk)
         m1, _, d1 = deliver(src, dst, evalid & (typ == 1), n, cap,
                             compact_chunk)
         return m0, m1, d0 + d1
     key_full = jnp.where(evalid, typ * n + dst, n2).astype(jnp.int32)
+    spilling = spill is not None or spill_in is not None
+    if spilling:
+        # Spill needs the carry-chained chunk machinery; a chunk covering
+        # the whole stream reproduces the single-pass result exactly.
+        chunk = min(compact_chunk or m, m)
+        carry = None
+        if spill_in is not None:
+            carry = (jnp.full((n2 * cap + 1,), -1, dtype=jnp.int32),
+                     jnp.zeros((n2 + 1,), dtype=jnp.int32),
+                     jnp.zeros((), jnp.int32))
+            carry, spill = deliver_spill_pairs(carry, spill_in, n2, cap,
+                                               rank_major=flat, spill=spill)
+        if prefix_len is not None:
+            out = _deliver_prefix_keyed(src, key_full, prefix_len, n2, cap,
+                                        chunk, carry=carry, rank_major=flat,
+                                        spill=spill)
+        else:
+            out = _deliver_compact_keyed(src, key_full, evalid, n2, cap,
+                                         chunk, carry=carry,
+                                         rank_major=flat, spill=spill)
+        if spill is not None:
+            mbox, count, dropped, spill_out = out
+        else:
+            mbox, count, dropped = out
+            spill_out = None
+        res = ((mbox,
+                jnp.minimum(count[:n].max(initial=0), cap),
+                jnp.minimum(count[n:n2].max(initial=0), cap), dropped)
+               if flat else
+               (mbox[:n * cap].reshape(n, cap),
+                mbox[n * cap:n2 * cap].reshape(n, cap), dropped))
+        return res + (spill_out,) if spill_out is not None else res
     if compact_chunk is not None and compact_chunk < m:
-        mbox, count, dropped = _deliver_compact_keyed(
-            src, key_full, evalid, n2, cap, compact_chunk,
-            rank_major=flat)
+        if prefix_len is not None:
+            mbox, count, dropped = _deliver_prefix_keyed(
+                src, key_full, prefix_len, n2, cap, compact_chunk,
+                rank_major=flat)
+        else:
+            mbox, count, dropped = _deliver_compact_keyed(
+                src, key_full, evalid, n2, cap, compact_chunk,
+                rank_major=flat)
     else:
         sd, ss = jax.lax.sort((key_full, src.astype(jnp.int32)),
                               num_keys=1, is_stable=True)
@@ -435,7 +531,7 @@ def _deliver_columns_impl(mats, n, cap, chunk, flat, carry, spill_in=None,
     return res + (spill,) if spill is not None else res
 
 
-def make_hosted_column_delivery(n: int, cap: int, chunk: int,
+def make_hosted_column_delivery(n: int, cap: int, chunk,
                                 per_call_chunks: int = 256,
                                 spill_cap: int = 0):
     """deliver_columns(flat=True) as a HOST-driven sequence of bounded
@@ -444,9 +540,26 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
     minutes of chunks at n=1e8 (the bootstrap burst is ~1526 64k-chunks)
     and a single device call past ~10 s gets the axon worker killed
     (UNAVAILABLE; the calibration note in overlay_ticks.run_call_budget),
-    so the chunk loop runs `per_call_chunks` trips per jitted call with
+    so the chunk loop runs a bounded number of trips per jitted call with
     the carry donated across calls.  Rows with zero emissions cost one
-    jitted popcount -- CHEAPER than the fused form's full scan.
+    jitted popcount -- CHEAPER than the fused form's full scan -- or
+    NOTHING when the caller already knows the row's total (run's
+    `row_totals`, the round-7 dead-row skip: the overlay pieces count
+    each slot's emissions at write time, so settled rounds never touch
+    the ~16 dead n-wide rows at all).
+
+    `chunk` is an int or an ascending WIDTH LADDER (round 7,
+    overlay.hosted_chunk_widths): each row picks the narrowest ladder
+    width that covers its live total in one chunk, falling back to the
+    fattest for burst rows -- fat chunks amortize the per-chunk flat
+    scatter floors that dominate dense rows (profile_overlay.py measures
+    the per-width constants), narrow ones keep settled rows at the swept
+    small-chunk optimum.  Chunk width never changes results (ascending
+    ranges + rank continuation -- deliver's compact_chunk contract), so
+    the schedule is pure perf; each width's kernels compile lazily on
+    first use.  The per-call trip budget scales inversely with width
+    (constant lanes per call), keeping every call inside the watchdog
+    calibration done at the base width.
 
     Bit-identical to deliver_columns(..., flat=True): same chunk body,
     same ascending-index order, same rank continuation (pinned by the
@@ -458,6 +571,11 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
     (2, spill_cap + 1) accumulator instead of dropping (see
     _compact_chunk_step), and the return gains the final pairs array --
     the memory-scale overlay's lossless-membership path."""
+    widths = tuple(sorted({int(w) for w in
+                           (chunk if isinstance(chunk, (tuple, list))
+                            else (chunk,))}))
+    base_chunk = widths[0]
+    per_call_lanes = per_call_chunks * base_chunk
     count_valid = jax.jit(lambda d: (d >= 0).sum(dtype=jnp.int32))
     finish = jax.jit(
         lambda count: jnp.minimum(count[:n].max(initial=0), cap))
@@ -471,64 +589,85 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
         return _compact_chunk_step(mbox, count, dropped, key, s, n, cap,
                                    rank_major=True, spill=spill)
 
-    @functools.partial(jax.jit,
-                       donate_argnums=(0, 1, 2, 3, 4, 5) if spilling
-                       else (0, 1, 2, 3))
-    def kstep(mbox, count, dropped, *rest):
-        if spilling:
-            pairs, scnt, remaining, dcol, trips = rest
-        else:
-            remaining, dcol, trips = rest
-
-        def body(i, carry):
+    def _make_ksteps(chunk_w: int):
+        @functools.partial(jax.jit,
+                           donate_argnums=(0, 1, 2, 3, 4, 5) if spilling
+                           else (0, 1, 2, 3))
+        def kstep(mbox, count, dropped, *rest):
             if spilling:
-                mbox, count, dropped, pairs, scnt, remaining = carry
+                pairs, scnt, remaining, dcol, trips = rest
             else:
-                mbox, count, dropped, remaining = carry
-            idx = first_true_indices(remaining, chunk)
-            hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
-            remaining = remaining & ~hit
+                remaining, dcol, trips = rest
+
+            def body(i, carry):
+                if spilling:
+                    mbox, count, dropped, pairs, scnt, remaining = carry
+                else:
+                    mbox, count, dropped, remaining = carry
+                idx = first_true_indices(remaining, chunk_w)
+                hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+                remaining = remaining & ~hit
+                if spilling:
+                    mbox, count, dropped, (pairs, scnt) = _chunk_body(
+                        mbox, count, dropped, idx, dcol,
+                        spill=(pairs, scnt))
+                    return mbox, count, dropped, pairs, scnt, remaining
+                mbox, count, dropped = _chunk_body(mbox, count, dropped,
+                                                   idx, dcol)
+                return mbox, count, dropped, remaining
+
+            init = ((mbox, count, dropped, pairs, scnt, remaining)
+                    if spilling else (mbox, count, dropped, remaining))
+            return jax.lax.fori_loop(0, trips, body, init)
+
+        @functools.partial(jax.jit,
+                           donate_argnums=(0, 1, 2, 3, 4) if spilling
+                           else (0, 1, 2))
+        def kstep_dense(mbox, count, dropped, *rest):
+            """Fully-valid row (every lane emits -- the bootstrap burst):
+            chunks are plain ascending ranges, no compaction scan at all.
+            Bit-identical to kstep on an all-true mask (first_true_indices
+            of all-true IS the ascending range)."""
             if spilling:
-                mbox, count, dropped, (pairs, scnt) = _chunk_body(
-                    mbox, count, dropped, idx, dcol, spill=(pairs, scnt))
-                return mbox, count, dropped, pairs, scnt, remaining
-            mbox, count, dropped = _chunk_body(mbox, count, dropped, idx,
-                                               dcol)
-            return mbox, count, dropped, remaining
-
-        init = ((mbox, count, dropped, pairs, scnt, remaining) if spilling
-                else (mbox, count, dropped, remaining))
-        return jax.lax.fori_loop(0, trips, body, init)
-
-    @functools.partial(jax.jit,
-                       donate_argnums=(0, 1, 2, 3, 4) if spilling
-                       else (0, 1, 2))
-    def kstep_dense(mbox, count, dropped, *rest):
-        """Fully-valid row (every lane emits -- the bootstrap burst):
-        chunks are plain ascending ranges, no compaction scan at all.
-        Bit-identical to kstep on an all-true mask (first_true_indices
-        of all-true IS the ascending range)."""
-        if spilling:
-            pairs, scnt, dcol, start, trips = rest
-        else:
-            dcol, start, trips = rest
-
-        def body(i, carry):
-            if spilling:
-                mbox, count, dropped, pairs, scnt = carry
+                pairs, scnt, dcol, start, trips = rest
             else:
-                mbox, count, dropped = carry
-            idx = start + i * chunk + jnp.arange(chunk, dtype=jnp.int32)
-            idx = jnp.minimum(idx, n)  # tail: clamp to the n sentinel
-            if spilling:
-                mbox, count, dropped, (pairs, scnt) = _chunk_body(
-                    mbox, count, dropped, idx, dcol, spill=(pairs, scnt))
-                return mbox, count, dropped, pairs, scnt
-            return _chunk_body(mbox, count, dropped, idx, dcol)
+                dcol, start, trips = rest
 
-        init = ((mbox, count, dropped, pairs, scnt) if spilling
-                else (mbox, count, dropped))
-        return jax.lax.fori_loop(0, trips, body, init)
+            def body(i, carry):
+                if spilling:
+                    mbox, count, dropped, pairs, scnt = carry
+                else:
+                    mbox, count, dropped = carry
+                idx = start + i * chunk_w + jnp.arange(chunk_w,
+                                                       dtype=jnp.int32)
+                idx = jnp.minimum(idx, n)  # tail: clamp to the n sentinel
+                if spilling:
+                    mbox, count, dropped, (pairs, scnt) = _chunk_body(
+                        mbox, count, dropped, idx, dcol,
+                        spill=(pairs, scnt))
+                    return mbox, count, dropped, pairs, scnt
+                return _chunk_body(mbox, count, dropped, idx, dcol)
+
+            init = ((mbox, count, dropped, pairs, scnt) if spilling
+                    else (mbox, count, dropped))
+            return jax.lax.fori_loop(0, trips, body, init)
+
+        return kstep, kstep_dense
+
+    ksteps: dict = {}  # width -> (kstep, kstep_dense), compiled lazily
+
+    def _fns(chunk_w: int):
+        if chunk_w not in ksteps:
+            ksteps[chunk_w] = _make_ksteps(chunk_w)
+        return ksteps[chunk_w]
+
+    def _pick_width(total: int) -> int:
+        """Narrowest ladder width covering `total` in ONE chunk, else the
+        fattest (burst rows: fat chunks amortize the flat scatter floor)."""
+        for w in widths:
+            if total <= w:
+                return w
+        return widths[-1]
 
     remaining_jit = jax.jit(lambda d: d >= 0)
 
@@ -540,7 +679,7 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
                                         spill=(pairs, scnt))
         return carry + sp
 
-    def run(mats, spill_in=None):
+    def run(mats, spill_in=None, row_totals=None):
         mbox = jnp.full((n * cap + 1,), -1, dtype=jnp.int32)
         count = jnp.zeros((n + 1,), dtype=jnp.int32)
         dropped = jnp.zeros((), jnp.int32)
@@ -551,34 +690,44 @@ def make_hosted_column_delivery(n: int, cap: int, chunk: int,
                 mbox, count, dropped, pairs, scnt = kspill_in(
                     mbox, count, dropped, pairs, scnt, spill_in)
                 jax.block_until_ready(mbox)
+        ri = 0
         for mat in mats:
             for c in range(mat.shape[0]):
                 dcol = mat[c]
-                total = int(jax.device_get(count_valid(dcol)))
-                chunks = -(-total // chunk)
-                if chunks == 0:
+                if row_totals is not None:
+                    # Caller-supplied exact total (counted at emission
+                    # time): zero rows skip without touching the array.
+                    total = int(row_totals[ri])
+                else:
+                    total = int(jax.device_get(count_valid(dcol)))
+                ri += 1
+                if total == 0:
                     continue
+                cw = _pick_width(total)
+                kstep, kstep_dense = _fns(cw)
+                chunks = -(-total // cw)
+                per_call = max(1, per_call_lanes // cw)
                 if total == int(dcol.shape[0]):
                     # Fully-valid row (the bootstrap burst): ascending
                     # ranges, no compaction scans.
                     done = 0
                     while done < chunks:
-                        t = min(per_call_chunks, chunks - done)
+                        t = min(per_call, chunks - done)
                         if spilling:
                             mbox, count, dropped, pairs, scnt = kstep_dense(
                                 mbox, count, dropped, pairs, scnt, dcol,
-                                jnp.int32(done * chunk), jnp.int32(t))
+                                jnp.int32(done * cw), jnp.int32(t))
                         else:
                             mbox, count, dropped = kstep_dense(
                                 mbox, count, dropped, dcol,
-                                jnp.int32(done * chunk), jnp.int32(t))
+                                jnp.int32(done * cw), jnp.int32(t))
                         jax.block_until_ready(mbox)
                         done += t
                     continue
                 remaining = remaining_jit(dcol)
                 done = 0
                 while done < chunks:
-                    t = min(per_call_chunks, chunks - done)
+                    t = min(per_call, chunks - done)
                     if spilling:
                         (mbox, count, dropped, pairs, scnt,
                          remaining) = kstep(mbox, count, dropped, pairs,

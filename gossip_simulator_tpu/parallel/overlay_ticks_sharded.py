@@ -35,9 +35,13 @@ I32 = jnp.int32
 
 
 def overlay_tick_state_specs() -> ot.OverlayTickState:
+    # spill: the sharded ticks engine keeps counted drops (no routed spill
+    # path, like the sharded rounds overlay), so the field is the token
+    # (2, 1) constant -- replicated, never written.
     return ot.OverlayTickState(
         friends=P(AXIS, None), friend_cnt=P(AXIS),
         ring_dst=P(AXIS), ring_pay=P(AXIS), ring_cnt=P(AXIS, None),
+        spill=P(None, None),
         tick=P(), makeups=P(), breakups=P(),
         win_makeups=P(), win_breakups=P(), mailbox_dropped=P())
 
@@ -117,6 +121,7 @@ def make_sharded_init(cfg: Config, mesh):
         return ot.OverlayTickState(
             friends=friends, friend_cnt=cnt,
             ring_dst=ring_dst, ring_pay=ring_pay, ring_cnt=ring_cnt,
+            spill=jnp.full((2, 1), -1, I32),
             tick=z, makeups=z, breakups=z,
             win_makeups=z, win_breakups=z,
             mailbox_dropped=jax.lax.psum(dropped, AXIS))

@@ -206,9 +206,13 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
     if ckpt_mode == "rounds":
         from gossip_simulator_tpu.models import overlay as _ov
 
-        sc = (_ov.SPILL_CAP
-              if _ov.spill_enabled(cfg.mailbox_cap_for(n // n_shards))
-              else 0)
+        # Target spill size = what init_state would build for this run
+        # (single-device: burst-sized at the static-boot band, round 7;
+        # sharded: the flat floor -- the hook path never spills).
+        sc = (_ov.spill_cap_for(cfg, n) if n_shards == 1
+              else (_ov.SPILL_CAP
+                    if _ov.spill_enabled(cfg.mailbox_cap_for(n // n_shards))
+                    else 0))
         if n_shards > 1:
             # The sharded rounds engine's routed delivery has no spill
             # path (overlay_state_specs note): live pairs restored onto a
@@ -245,6 +249,32 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
             "-fanout/-fanin")
     n_local = n // n_shards
     if ckpt_mode == "ticks":
+        # Round-7 spill coercion, mirroring the rounds branch above: the
+        # ticks engine's mailbox-overflow spill (overlay_ticks.spill) is
+        # (pay, packed-key) pairs; pre-round-7 snapshots have no overflow
+        # in flight, the sharded engine has no spill delivery (live pairs
+        # would block quiescence forever), and size drift re-pads
+        # preserving in-flight pairs.
+        sc = ot.ticks_spill_cap(cfg) if n_shards == 1 else 0
+        if n_shards > 1 and "spill" in tree and (
+                np.asarray(tree["spill"])[1] >= 0).any():
+            raise ValueError(
+                "snapshot holds undelivered ticks-overlay spill overflow "
+                "pairs; the sharded overlay engine cannot deliver them -- "
+                "finish phase 1 (or at least drain the spill) "
+                "single-device before resharding")
+        if "spill" not in tree:
+            tree["spill"] = np.full((2, sc + 1), -1, np.int32)
+        elif tuple(tree["spill"].shape) != (2, sc + 1):
+            old_arr = np.asarray(tree["spill"])
+            live = old_arr[:, old_arr[1] >= 0]
+            if live.shape[1] > sc:
+                raise ValueError(
+                    f"checkpoint spill holds {live.shape[1]} in-flight "
+                    f"pairs but this build's spill capacity is {sc}")
+            pad = np.full((2, sc + 1), -1, np.int32)
+            pad[:, :live.shape[1]] = live
+            tree["spill"] = pad
         dw = ot.ring_windows(cfg)
         if tuple(tree["ring_cnt"].shape) != (n_shards, dw):
             raise ValueError(

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Profile phase-1 overlay construction's cost floors (VERDICT r5 #2 /
+ISSUE 4 tentpole) -- the sibling of profile_exchange.py for the hosted
+split-round delivery that dominated the 260.9 s `two_phase_100m` flagship
+run (~236 s of it was overlay construction, r5).
+
+Three measurements, on THIS host's devices (TPU when the axon pool is up,
+CPU otherwise), so the constants behind the README phase-1 cost-model
+table are measured, not assumed:
+
+  * `chunk_floor`: one hosted delivery chunk's cost at each ladder width,
+    dense (ascending ranges: sort + rank + flat scatter + count add) and
+    masked (adds the n-wide first_true_indices compaction scan) -- the
+    per-chunk scatter floor the adaptive schedule amortizes and the scan
+    the dead-row skip / prefix drain remove;
+  * `row_floor`: the n-wide per-row fixed costs -- the zero-row popcount
+    (what the dead-row skip eliminates, x~16 rows/round once settled) and
+    the eager quiesced() emission-mask reduction (what the counts-based
+    scalar quiescence replaces);
+  * `round_pieces`: wall-clock per split round of a real (scaled-down)
+    overlay build, with the per-round processed counts -- where a round's
+    time actually goes as the burst decays into the settled regime, under
+    the round-7 gates (toggle with --static-boot/--adaptive/--dead-skip).
+
+Each row reports seconds/call and derived ns/lane.  Results land in one
+JSON (default PROFILE_OVERLAY.json next to the repo's other artifacts);
+nothing here mutates simulator state.
+
+Usage:
+    python scripts/profile_overlay.py                    # defaults
+    python scripts/profile_overlay.py --n 100000000 --rounds 8   # TPU scale
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_simulator_tpu.utils import jaxsetup  # noqa: E402
+
+jaxsetup.setup()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gossip_simulator_tpu.config import Config  # noqa: E402
+from gossip_simulator_tpu.models import overlay as ov  # noqa: E402
+from gossip_simulator_tpu.ops.mailbox import (  # noqa: E402
+    make_hosted_column_delivery)
+from gossip_simulator_tpu.ops.select import first_true_indices  # noqa: E402
+
+
+def _timeit(fn, iters: int) -> float:
+    out = fn()  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def profile_chunk_floor(n: int, cap: int, widths, iters: int) -> dict:
+    """One hosted-delivery chunk at each ladder width, dense and masked.
+    The row is fully valid (the bootstrap-burst shape) for the dense form
+    and 25%-valid for the masked form; per-chunk seconds divide out the
+    chunk count so the FLOOR (sort + rank + flat scatter into the
+    n*cap-cell mailbox + count add [+ n-wide scan]) is what's left."""
+    rng = np.random.default_rng(0)
+    dense_row = jnp.asarray(rng.integers(0, n, n, dtype=np.int32))
+    sparse = np.where(rng.random(n) < 0.25,
+                      rng.integers(0, n, n), -1).astype(np.int32)
+    sparse_row = jnp.asarray(sparse)
+    sparse_total = int((sparse >= 0).sum())
+    rows = {}
+    for w in widths:
+        run = make_hosted_column_delivery(n, cap, w,
+                                          per_call_chunks=1 << 30)
+        dense_chunks = -(-n // w)
+        t_dense = _timeit(lambda: run((dense_row[None, :],)), iters)
+        masked_chunks = -(-sparse_total // w)
+        t_masked = _timeit(lambda: run((sparse_row[None, :],)), iters)
+        rows[str(w)] = {
+            "dense_chunks": dense_chunks,
+            "dense_s_per_chunk": t_dense / dense_chunks,
+            "dense_ns_per_lane": t_dense * 1e9 / n,
+            "masked_chunks": masked_chunks,
+            "masked_s_per_chunk": t_masked / masked_chunks,
+            # The masked-minus-dense per-chunk delta ~= one n-wide
+            # compaction scan (the prefix-drain / dead-skip target).
+            "scan_s_per_chunk": max(
+                0.0, t_masked / masked_chunks - t_dense / dense_chunks),
+        }
+    return rows
+
+
+def profile_row_floor(n: int, cap: int, iters: int) -> dict:
+    """Per-ROW fixed costs the round-7 gates remove: the zero-row
+    popcount (dead-row skip) and the eager (cap, n) emission-mask
+    quiescence reduction (counts-based scalar quiescence)."""
+    dead = jnp.full((n,), -1, jnp.int32)
+    em = jnp.full((cap, n), -1, jnp.int32)
+    popcount = jax.jit(lambda d: (d >= 0).sum(dtype=jnp.int32))
+    masks = jax.jit(lambda a, b: (a >= 0).sum(dtype=jnp.int32)
+                    + (b >= 0).sum(dtype=jnp.int32))
+    scan = jax.jit(lambda d: first_true_indices(d >= 0, 4096))
+    return {
+        "popcount_s": _timeit(lambda: popcount(dead), iters),
+        "emission_mask_reduce_s": _timeit(lambda: masks(em, em), iters),
+        "first_true_scan_s": _timeit(lambda: scan(dead), iters),
+    }
+
+
+def profile_round_pieces(n: int, max_rounds: int, static_boot: str,
+                         adaptive: str, dead_skip: str) -> dict:
+    """Wall-clock per split round of a real overlay build at `n`
+    (SPLIT_ROUND_MIN_ROWS lowered so the hosted path runs at any n),
+    with per-round processed counts -- the decay from burst to settled
+    is where the adaptive schedule and dead-row skip earn their keep."""
+    from gossip_simulator_tpu.backends.jax_backend import JaxStepper
+
+    ov.SPLIT_ROUND_MIN_ROWS = 0  # route this build through the split path
+    cfg = Config(n=n, graph="overlay", overlay_mode="rounds",
+                 backend="jax", seed=0, progress=False,
+                 overlay_static_boot=static_boot,
+                 overlay_adaptive_chunks=adaptive,
+                 overlay_dead_skip=dead_skip).validate()
+    s = JaxStepper(cfg)
+    t0 = time.perf_counter()
+    s.init()
+    init_s = time.perf_counter() - t0
+    rounds = []
+    for _ in range(max_rounds):
+        t0 = time.perf_counter()
+        mk, bk, q = s.overlay_window()
+        rounds.append({"s": round(time.perf_counter() - t0, 4),
+                       "makeups": mk, "breakups": bk})
+        if q:
+            break
+    return {
+        "n": n, "init_s": round(init_s, 4),
+        "static_boot": static_boot, "adaptive": adaptive,
+        "dead_skip": dead_skip,
+        "quiesced": bool(q), "rounds": rounds,
+        "total_s": round(sum(r["s"] for r in rounds), 4),
+        # Steady-state floor: the mean of the last 3 (settled) rounds.
+        "settled_s_per_round": round(
+            float(np.mean([r["s"] for r in rounds[-3:]])), 4),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=None,
+                    help="chunk/row-floor lane count (default: 16777216 "
+                         "on TPU, 1048576 on CPU)")
+    ap.add_argument("--rounds-n", type=int, default=None,
+                    help="round_pieces build size (default: n // 8)")
+    ap.add_argument("--rounds", type=int, default=40)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--static-boot", default="on",
+                    choices=("auto", "on", "off"))
+    ap.add_argument("--adaptive", default="on",
+                    choices=("auto", "on", "off"))
+    ap.add_argument("--dead-skip", default="on",
+                    choices=("auto", "on", "off"))
+    ap.add_argument("--skip-rounds", action="store_true",
+                    help="only the chunk/row floors (fast)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PROFILE_OVERLAY.json"))
+    args = ap.parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    n = args.n or (16_777_216 if on_tpu else 1_048_576)
+    cap = Config(n=n).mailbox_cap_for(n)
+    widths = ov.hosted_chunk_widths(Config(n=n), n)
+    rec = {"device": jax.devices()[0].device_kind,
+           "backend": jax.default_backend(),
+           "n": n, "cap": cap, "widths": list(widths),
+           "iters": args.iters, "rows": {}}
+    rec["rows"]["chunk_floor"] = profile_chunk_floor(n, cap, widths,
+                                                     args.iters)
+    rec["rows"]["row_floor"] = profile_row_floor(n, cap, args.iters)
+    if not args.skip_rounds:
+        rn = args.rounds_n or max(65_536, n // 8)
+        rec["rows"]["round_pieces"] = profile_round_pieces(
+            rn, args.rounds, args.static_boot, args.adaptive,
+            args.dead_skip)
+    with open(args.out, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps({k: v for k, v in rec.items() if k != "rows"}
+                     | {"out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -260,11 +260,15 @@ def test_slotmajor_band_small_n(monkeypatch):
     reaches at n >= 3.2e7, where the node-major layouts tile-pad to
     51 GB at compile.  Lowering the band constant routes a 2000-node
     build through the exact large-n code path; the pinned trajectory is
-    the band's own (lane-keyed draws differ from node-keyed by design --
-    the node-major path gives 24 windows / 240 ms at this seed too, but
-    different message totals).  The forced cap-8 mailbox genuinely
-    overflows at this shape; ticks-mode overflow stays COUNTED (the
-    lossless spill is the rounds engine's; divergence table in README)."""
+    the band's own (lane-keyed draws differ from node-keyed by design).
+    The forced cap-8 mailbox genuinely overflows at this shape; since
+    round 7 the overflow SPILLS and re-delivers next window (delayed,
+    never lost -- the reference's channel-full backpressure,
+    simulator.go:51-54), so the band build ends mailbox_dropped=0; the
+    SPILL_CAP=0 control in test_ticks_spill_makes_overflow_lossless
+    proves the same shape genuinely overflows.  (Values re-pinned on the
+    round-7 host -- this jax's RNG stream drifted from the original pin,
+    the known golden-drift class of BENCH_SELF_r06.)"""
     import jax
 
     import gossip_simulator_tpu.config as config_mod
@@ -277,13 +281,14 @@ def test_slotmajor_band_small_n(monkeypatch):
                  backend="jax", fanout=5, seed=9, progress=False,
                  coverage_target=0.9).validate()
     assert ot.slotmajor(cfg.n)
+    assert ot.ticks_spill_cap(cfg) > 0  # the band spills now
     s = JaxStepper(cfg)
     s.init()
     windows, q = s.overlay_run_to_quiescence(20_000)
     assert bool(q)
-    assert windows == 24
-    assert s._stabilize_ms == 240.0
+    assert windows == 19
+    assert s._stabilize_ms == 190.0
     cnt = np.asarray(jax.device_get(s.state.friend_cnt))
     assert (cnt >= cfg.fanout).all()
     assert (cnt <= cfg.max_degree).all()
-    assert s._mailbox_dropped == 246  # counted, never silent
+    assert s._mailbox_dropped == 0  # spilled, never lost
