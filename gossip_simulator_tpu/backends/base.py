@@ -142,8 +142,12 @@ def run_bounded_to_target(stepper) -> Stats:
         # Exhaustion is recorded whatever ends the run (the windowed loop's
         # per-window flag ends up reflecting the LAST window too), so a wave
         # that dies in the same window the round cap is hit still reports
-        # "exhausted" -- reason parity with the windowed path.
-        if in_flight == 0 and cfg.protocol != "pushpull":
+        # "exhausted" -- reason parity with the windowed path.  Healing can
+        # revive an empty ring (a pending dead-friend detection re-sends
+        # from an already-infected healer), so heal-on runs never exit on
+        # emptiness -- they run to target or max_rounds.
+        if (in_flight == 0 and cfg.protocol != "pushpull"
+                and not cfg.overlay_heal_resolved):
             stepper.exhausted = True
         if (recv >= target or tick >= cfg.max_rounds
                 or stepper.exhausted):

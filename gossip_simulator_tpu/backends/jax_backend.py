@@ -248,7 +248,12 @@ class JaxStepper(Stepper):
     def gossip_window(self) -> Stats:
         self.state = self._window_fn(self.state, self.key)
         stats, in_flight = self._stats_and_inflight()
-        self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
+        # Heal-on runs never report exhaustion mid-run: a pending dead-
+        # friend detection can re-send from an infected healer and revive
+        # an empty ring (see base.run_bounded_to_target).
+        self.exhausted = (in_flight == 0
+                          and self.cfg.protocol != "pushpull"
+                          and not self.cfg.overlay_heal_resolved)
         stats.exhausted = self.exhausted
         return stats
 
@@ -293,14 +298,19 @@ class JaxStepper(Stepper):
         extra = st.mail_dropped if hasattr(st, "mail_dropped") else 0
         rem = (event.removed_count(st)
                if self.cfg.protocol == "sir" else 0)
-        tm, tr, tc, trm, tick, dropped, in_flight = jax.device_get(
+        (tm, tr, tc, trm, tick, dropped, in_flight, sc, sr, pd,
+         hr) = jax.device_get(
             (st.total_message, st.total_received, st.total_crashed,
-             rem, st.tick, extra, event.in_flight(st)))
+             rem, st.tick, extra, event.in_flight(st),
+             st.scen_crashed, st.scen_recovered, st.part_dropped,
+             st.heal_repaired))
         return Stats(
             n=self.cfg.n, round=int(tick),
             total_received=int(tr), total_message=msg64_value(tm),
             total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
+            scen_crashed=int(sc), scen_recovered=int(sr),
+            part_dropped=int(pd), heal_repaired=int(hr),
             exhausted=self.exhausted,
         ), int(in_flight)
 

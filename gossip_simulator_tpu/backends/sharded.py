@@ -230,11 +230,11 @@ class ShardedStepper(Stepper):
             from gossip_simulator_tpu.parallel import event_sharded
 
             build = _event.init_state
-            out_specs = event_sharded.event_state_specs()
+            out_specs = event_sharded.event_state_specs(cfg)
         else:
             def build(c, friends, cnt):
                 return epidemic.init_state(c, friends, cnt, n_local=n_local)
-            out_specs = sharded_step.sim_state_specs()
+            out_specs = sharded_step.sim_state_specs(cfg)
 
         from gossip_simulator_tpu.parallel.mesh import shard_map
 
@@ -254,7 +254,11 @@ class ShardedStepper(Stepper):
         self.state = self._window_fn(self.state, self.key)
         stats = self.stats()
         in_flight = int(jax.device_get(_inflight(self.state)))
-        self.exhausted = in_flight == 0 and self.cfg.protocol != "pushpull"
+        # Heal-on runs never report exhaustion mid-run (see
+        # base.run_bounded_to_target).
+        self.exhausted = (in_flight == 0
+                          and self.cfg.protocol != "pushpull"
+                          and not self.cfg.overlay_heal_resolved)
         stats.exhausted = self.exhausted
         return stats
 
@@ -288,15 +292,20 @@ class ShardedStepper(Stepper):
         extra = st.mail_dropped if hasattr(st, "mail_dropped") else 0
         rem = (event_mod.removed_count(st)
                if self.cfg.protocol == "sir" else 0)
-        tm, tr, tc, trm, xo, tick, dropped = jax.device_get(
+        (tm, tr, tc, trm, xo, tick, dropped, sc, sr, pd,
+         hr) = jax.device_get(
             (st.total_message, st.total_received, st.total_crashed,
-             rem, st.exchange_overflow, st.tick, extra))
+             rem, st.exchange_overflow, st.tick, extra,
+             st.scen_crashed, st.scen_recovered, st.part_dropped,
+             st.heal_repaired))
         return Stats(
             n=self.cfg.n, round=int(tick),
             total_received=int(tr), total_message=msg64_value(tm),
             total_crashed=int(tc), total_removed=int(trm),
             mailbox_dropped=self._mailbox_dropped + int(dropped),
             exchange_overflow=int(xo),
+            scen_crashed=int(sc), scen_recovered=int(sr),
+            part_dropped=int(pd), heal_repaired=int(hr),
             exhausted=self.exhausted,
         )
 
@@ -392,9 +401,9 @@ class ShardedStepper(Stepper):
         tree = prepare_restore_tree(tree, cfg, n_shards=mesh.shape[AXIS])
         self._mailbox_dropped = int(tree.pop("host_mailbox_dropped", 0))
         if cfg.engine_resolved == "event":
-            cls, specs = EventState, event_sharded.event_state_specs()
+            cls, specs = EventState, event_sharded.event_state_specs(cfg)
         else:
-            cls, specs = SimState, sharded_step.sim_state_specs()
+            cls, specs = SimState, sharded_step.sim_state_specs(cfg)
         # jnp.array (device COPY) before placement: on the CPU platform
         # device_put of a host array can be zero-copy, and the restored
         # leaves feed straight into DONATING jitted fns -- XLA then reuses

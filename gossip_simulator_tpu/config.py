@@ -228,6 +228,24 @@ class Config:
     # pay the windowed host loop).  jax/sharded backends only; the
     # discrete-event oracles have no device loop to instrument.
     telemetry: str = "on"
+    # --- fault-injection scenario (gossip_simulator_tpu/scenario.py) --------
+    # "off" (default: traced programs identical to a scenario-less build),
+    # a path to a JSON timeline, or the JSON inline.  Schedules crash
+    # waves, steady churn, node recovery after `downtime` ms, and
+    # partition masks over simulated time; jax/sharded backends, SI/SIR
+    # ticks-and-rounds epidemics.  Draws are (window, GLOBAL-id)-keyed so
+    # trajectories are shard-count invariant and survive reshard-resume.
+    scenario: str = "off"
+    # Overlay self-healing during phase 2: every poll window, live nodes
+    # replace friends that have been dead >= heal_detect_ms (the windowed
+    # failed-delivery detection -- a dead friend black-holes every send,
+    # so detect_ms models how long the sender's delivery accounting takes
+    # to condemn it) with a fresh uniform peer, re-entering the phase-1
+    # makeup draw (overlay.heal_dead_friends); infected healers re-send
+    # the rumor over the repaired edge (the rejoin anti-entropy that lets
+    # recovered nodes catch up).  Works on any friends-table graph.
+    overlay_heal: str = "off"
+    heal_detect_ms: int = 30
     # Print the end-of-run telemetry block (phase breakdown, throughput).
     telemetry_summary: bool = False
 
@@ -285,11 +303,38 @@ class Config:
         return self.crashrate
 
     @property
+    def scenario_resolved(self):
+        """Parsed fault-injection Scenario (scenario.OFF when "off") --
+        module-cached, so the jitted closures all see one object."""
+        from gossip_simulator_tpu import scenario as _scen
+
+        return _scen.parse(self.scenario)
+
+    @property
+    def overlay_heal_resolved(self) -> bool:
+        return self.overlay_heal == "on"
+
+    @property
+    def faults_enabled(self) -> bool:
+        """Whether the phase-2 steps carry the fault machinery (the
+        per-node down_since crash clock and the scenario tick): scenario
+        crash/churn/recovery events, or healing -- whose dead-friend
+        detection reads the same clock."""
+        return (self.scenario_resolved.has_faults
+                or self.overlay_heal_resolved)
+
+    @property
     def dup_suppress_resolved(self) -> bool:
         """Whether the event engine suppresses guaranteed-duplicate edges
         at append (see the `dup_suppress` field comment).  Only sound at
-        crash_p == 0; validate() rejects an explicit "on" otherwise."""
+        crash_p == 0; validate() rejects an explicit "on" otherwise.
+        Scenario faults also force it off: a suppressed edge's count is
+        credited assuming delivery to a live node, but a scenario crash
+        can black-hole the destination between append and delivery --
+        the unsuppressed path would then NOT count it."""
         if self.dup_suppress == "off":
+            return False
+        if self.scenario_resolved.has_faults:
             return False
         return self.crashrate_eff == 0.0
 
@@ -487,6 +532,42 @@ class Config:
                 "entries would shift every later draw).  Note the "
                 "reference's own default crashrate 0.001 IS 0 under "
                 "-compat-reference (1%-resolution truncation).")
+        # --- fault-injection scenario ------------------------------------
+        scen = self.scenario_resolved  # raises ValueError on a bad spec
+        if scen.active:
+            if self.backend not in ("jax", "sharded"):
+                raise ValueError(
+                    "-scenario requires backend=jax or sharded (the "
+                    "discrete-event oracles have no fault timeline)")
+            if self.protocol == "pushpull":
+                raise ValueError(
+                    "-scenario supports protocol=si|sir (push-pull has "
+                    "no send-time wave for the partition mask to filter)")
+            if scen.groups > self.n:
+                raise ValueError(
+                    f"scenario groups ({scen.groups}) cannot exceed n "
+                    f"({self.n})")
+            if self.dup_suppress == "on" and scen.has_faults:
+                raise ValueError(
+                    "-dup-suppress on is unsound under scenario faults: "
+                    "a banked duplicate credit assumes delivery to a "
+                    "live node, but a scenario crash can black-hole the "
+                    "destination before its window drains")
+        if self.overlay_heal not in ("on", "off"):
+            raise ValueError(
+                f"overlay_heal must be on|off, got {self.overlay_heal!r}")
+        if self.overlay_heal_resolved:
+            if self.backend not in ("jax", "sharded"):
+                raise ValueError(
+                    "-overlay-heal requires backend=jax or sharded")
+            if self.protocol == "pushpull":
+                raise ValueError(
+                    "-overlay-heal is meaningless for push-pull (fresh "
+                    "random peers every round; there is no friends table "
+                    "to repair)")
+        if self.heal_detect_ms < 0:
+            raise ValueError(
+                f"heal_detect_ms must be >= 0, got {self.heal_detect_ms}")
         if self.engine == "event":
             if (self.protocol not in ("si", "sir")
                     or self.effective_time_mode != "ticks"):
@@ -649,6 +730,20 @@ def _build_parser() -> argparse.ArgumentParser:
                    dest="telemetry_summary", action="store_true",
                    help="print the end-of-run telemetry block (phase "
                         "breakdown, throughput)")
+    p.add_argument("-scenario", "--scenario", default=d.scenario,
+                   help="fault-injection timeline: 'off', a JSON file "
+                        "path, or inline JSON (crash waves, churn, "
+                        "recovery downtime, partition masks -- see "
+                        "scenario.py)")
+    p.add_argument("-overlay-heal", "--overlay-heal", dest="overlay_heal",
+                   choices=("on", "off"), default=d.overlay_heal,
+                   help="phase-2 overlay self-healing: replace detected-"
+                        "dead friends via the phase-1 makeup draw and "
+                        "re-send the rumor over repaired edges")
+    p.add_argument("-heal-detect-ms", "--heal-detect-ms",
+                   dest="heal_detect_ms", type=int, default=d.heal_detect_ms,
+                   help="ms of failed deliveries before a dead friend is "
+                        "condemned and replaced")
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

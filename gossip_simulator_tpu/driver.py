@@ -56,6 +56,18 @@ def _run(cfg: Config, printer: ProgressPrinter,
     stepper = stepper or make_stepper(cfg)
 
     printer.params(cfg.parameter_dump())
+    scen = cfg.scenario_resolved
+    if scen.active or cfg.overlay_heal_resolved:
+        # One-line fault-model banner (progress-only, like every note:
+        # quiet runs and non-primary ranks skip it) so a scenario run's
+        # transcript is self-describing.
+        printer.note(
+            f"scenario: {len(scen.crashes)} crash / {len(scen.churns)} "
+            f"churn / {len(scen.partitions)} partition events, "
+            f"groups={scen.groups}, downtime={scen.downtime}ms; "
+            f"overlay-heal {cfg.overlay_heal}"
+            + (f" (detect {cfg.heal_detect_ms}ms)"
+               if cfg.overlay_heal_resolved else ""))
     t_init = time.perf_counter()
     stepper.init()
     # The telemetry session (utils/telemetry.py) lets an observing run --

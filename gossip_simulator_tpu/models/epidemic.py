@@ -34,6 +34,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from gossip_simulator_tpu import scenario as _scen
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models.state import (SimState, in_flight,
                                                msg64_add, msg64_zero)
@@ -72,6 +73,9 @@ def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
         tick=z(), total_message=msg64_zero(), total_received=z(),
         total_crashed=z(),
         exchange_overflow=z(),
+        down_since=_scen.init_down_since(cfg.faults_enabled, n),
+        scen_crashed=z(), scen_recovered=z(), part_dropped=z(),
+        heal_repaired=z(),
     )
 
 
@@ -134,6 +138,12 @@ def tick_core(cfg: Config, st: SimState, keys: dict):
         new_crash = jnp.zeros((n,), bool)
     crashed = st.crashed | new_crash
     d_crashed = new_crash.sum(dtype=I32)
+    if cfg.faults_enabled and crash_p > 0.0:
+        # Reception crashes stamp the crash clock too: under a scenario
+        # every crash is subject to the reboot timeline (scenario.py's
+        # "machines reboot" model) and to the healer's detection window.
+        st = st._replace(down_since=jnp.where(
+            new_crash, st.tick.astype(I32), st.down_since))
 
     newly = has & ~crashed & ~st.received
     received = st.received | newly
@@ -166,21 +176,53 @@ def tick_core(cfg: Config, st: SimState, keys: dict):
     return st_partial, senders, dslot, (d_message, d_received, d_crashed)
 
 
+def apply_fault_window(cfg: Config, st: SimState, ids_global, base_key,
+                       nticks: int = 1):
+    """Apply the scenario's crash/churn/recovery timeline to a SimState for
+    the window [st.tick, st.tick + nticks) (scenario.fault_window; the ring
+    engine steps per tick, nticks=1).  Returns ``(st, d_crash, d_recover)``
+    with the masks applied but the replicated counters NOT yet updated --
+    the sharded caller psums the deltas first.  A no-op (st unchanged,
+    Python zeros) when the scenario has no fault events, so the traced
+    program is untouched at ``-scenario off``."""
+    scen = cfg.scenario_resolved
+    if not scen.has_faults:
+        return st, 0, 0
+    new_crash, recover, down, dc, drc = _scen.fault_window(
+        scen, cfg.n, st.tick, nticks, ids_global, st.crashed,
+        st.down_since, base_key)
+    crashed = (st.crashed & ~recover) | new_crash
+    return st._replace(crashed=crashed, down_since=down), dc, drc
+
+
 def edges_from_senders(cfg: Config, friends, friend_cnt, senders, dslot,
-                       drop_key):
+                       drop_key, tick=None, gid0=0):
     """Flatten this tick's outgoing wave into (dst_global, dslot, valid) flat
     arrays -- the message list the delivery layer (local scatter or
     cross-shard all_to_all route) consumes.  Per-link drop draw happens here
     (simulator.go:144), row-keyed so the compact path samples identically;
-    the shared per-broadcast delay came in via dslot."""
+    the shared per-broadcast delay came in via dslot.
+
+    `tick`/`gid0` feed the scenario partition mask (send-time semantics:
+    scenario.partition_blocked); the fourth return is the count of edges it
+    black-holed (a Python 0 when no partitions are configured, so the
+    -scenario off trace is unchanged).  `gid0` is the global id of local
+    row 0 (nonzero on the sharded backend's shards)."""
     n, k = friends.shape
     rows = jnp.arange(n, dtype=I32)
     drop = _rng.row_bernoulli(drop_key, p_eff(cfg, cfg.droprate), rows, k)
     edge = (jnp.arange(k, dtype=I32)[None, :] < friend_cnt[:, None]) \
         & senders[:, None] & ~drop & (friends >= 0)
+    scen = cfg.scenario_resolved
+    blocked_n = 0
+    if scen.has_partitions and tick is not None:
+        blocked = _scen.partition_blocked(
+            scen, cfg.n, tick, (gid0 + rows)[:, None], friends) & edge
+        blocked_n = blocked.sum(dtype=I32)
+        edge = edge & ~blocked
     dst = jnp.where(edge, friends, -1).reshape(-1)
     slots = jnp.broadcast_to(dslot[:, None], (n, k)).reshape(-1)
-    return dst, slots, edge.reshape(-1)
+    return dst, slots, edge.reshape(-1), blocked_n
 
 
 def compact_chunk_cap(cfg: Config, n_local: int) -> int:
@@ -196,12 +238,14 @@ def compact_chunk_cap(cfg: Config, n_local: int) -> int:
 
 
 def compact_gather(cfg: Config, friends, friend_cnt, dslot, delay_key,
-                   drop_key, tick, remaining, cap):
+                   drop_key, tick, remaining, cap, gid0=0):
     """Pull the next <=cap sender rows out of `remaining` and return their
-    edge list (dst, slot, valid) plus the updated remaining mask.  Fill rows
-    (index n) gather as invalid.  Drop masks and delay slots are row-keyed
-    (utils/rng.row_keys), drawn here for just the gathered rows -- bit-
-    identical to the dense path's draws for the same rows (tested)."""
+    edge list (dst, slot, valid) plus the updated remaining mask and the
+    scenario-partition block count (Python 0 with no partitions -- see
+    edges_from_senders).  Fill rows (index n) gather as invalid.  Drop
+    masks and delay slots are row-keyed (utils/rng.row_keys), drawn here
+    for just the gathered rows -- bit-identical to the dense path's draws
+    for the same rows (tested)."""
     n, k = friends.shape
     idx = first_true_indices(remaining, cap)
     hit = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
@@ -216,30 +260,53 @@ def compact_gather(cfg: Config, friends, friend_cnt, dslot, delay_key,
         sslot = row_slot(cfg, delay_key, tick, idx)
     edge = (jnp.arange(k, dtype=I32)[None, :] < scnt[:, None]) \
         & ~sdrop & (sf >= 0)
+    scen = cfg.scenario_resolved
+    blocked_n = 0
+    if scen.has_partitions:
+        # Same send-time predicate as the dense path, on just the gathered
+        # rows (fill rows' edges are already invalid).
+        blocked = _scen.partition_blocked(
+            scen, cfg.n, tick, (gid0 + idx)[:, None], sf) & edge
+        blocked_n = blocked.sum(dtype=I32)
+        edge = edge & ~blocked
     dst = jnp.where(edge, sf, -1).reshape(-1)
     slots = jnp.broadcast_to(sslot[:, None], (cap, k)).reshape(-1)
-    return dst, slots, edge.reshape(-1), remaining
+    return dst, slots, edge.reshape(-1), remaining, blocked_n
 
 
 def deposit_compact(cfg: Config, pending, friends, friend_cnt,
                     senders, dslot, delay_key, drop_key, tick):
     """Compacted equivalent of edges_from_senders + deposit_local: only
     actual sender rows reach the RNG, gather and scatter.  Row-keyed draws
-    keep the trajectory bit-identical to the dense path (tested)."""
+    keep the trajectory bit-identical to the dense path (tested).  Returns
+    ``(pending, partition_blocked_count)`` -- the count is a Python 0 (and
+    the loop carry is untouched) when no partitions are configured."""
     n, k = friends.shape
     cap = compact_chunk_cap(cfg, n)
     count = senders.sum(dtype=I32)
     chunks = (count + cap - 1) // cap
+    if cfg.scenario_resolved.has_partitions:
+        def body_p(_, carry):
+            pending, remaining, blk = carry
+            dst, slots, valid, remaining, b = compact_gather(
+                cfg, friends, friend_cnt, dslot, delay_key, drop_key,
+                tick, remaining, cap)
+            return deposit_local(pending, dst, slots, valid), remaining, \
+                blk + b
+
+        pending, _, blk = jax.lax.fori_loop(
+            0, chunks, body_p, (pending, senders, jnp.zeros((), I32)))
+        return pending, blk
 
     def body(_, carry):
         pending, remaining = carry
-        dst, slots, valid, remaining = compact_gather(
+        dst, slots, valid, remaining, _ = compact_gather(
             cfg, friends, friend_cnt, dslot, delay_key, drop_key, tick,
             remaining, cap)
         return deposit_local(pending, dst, slots, valid), remaining
 
     pending, _ = jax.lax.fori_loop(0, chunks, body, (pending, senders))
-    return pending
+    return pending, 0
 
 
 def deposit_local(pending, dst_local, slots, valid):
@@ -268,22 +335,30 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     # epidemic stalled).  Root-caused 2026-07-30; the skip also measured no
     # wall-clock win (empty slots are rare once delays spread the wave).
     def tick_fn(st: SimState, base_key: jax.Array) -> SimState:
+        st, dsc, dsr = apply_fault_window(
+            cfg, st, jnp.arange(st.received.shape[0], dtype=I32), base_key)
         keys = tick_keys(base_key, st.tick)
         stp, senders, dslot, (dm, dr, dc) = tick_core(cfg, st, keys)
         if cfg.compact_resolved:
-            pending = deposit_compact(
+            pending, blk = deposit_compact(
                 cfg, stp.pending, stp.friends, stp.friend_cnt, senders,
                 dslot, keys["delay"], keys["drop"], st.tick)
         else:
-            dst, slots, valid = edges_from_senders(
+            dst, slots, valid, blk = edges_from_senders(
                 cfg, stp.friends, stp.friend_cnt, senders, dslot,
-                keys["drop"])
+                keys["drop"], tick=st.tick)
             pending = deposit_local(stp.pending, dst, slots, valid)
-        return stp._replace(
+        stp = stp._replace(
             pending=pending,
             total_message=msg64_add(stp.total_message, dm),
             total_received=stp.total_received + dr,
             total_crashed=stp.total_crashed + dc)
+        if cfg.scenario_resolved.active:
+            stp = stp._replace(
+                scen_crashed=stp.scen_crashed + dsc,
+                scen_recovered=stp.scen_recovered + dsr,
+                part_dropped=stp.part_dropped + blk)
+        return stp
 
     return tick_fn
 
@@ -311,8 +386,11 @@ def make_seed_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
         if cfg.protocol == "pushpull":
             return st._replace(received=received, total_received=total_received)
         dslot = row_slot(cfg, kd, st.tick, jnp.arange(n, dtype=I32))
-        dst, slots, valid = edges_from_senders(
-            cfg, st.friends, st.friend_cnt, is_sender, dslot, kp)
+        dst, slots, valid, blk = edges_from_senders(
+            cfg, st.friends, st.friend_cnt, is_sender, dslot, kp,
+            tick=st.tick)
+        if cfg.scenario_resolved.has_partitions:
+            st = st._replace(part_dropped=st.part_dropped + blk)
         pending = deposit_local(st.pending, dst, slots, valid)
         rb = st.rebroadcast
         if cfg.protocol == "sir":
@@ -476,16 +554,64 @@ def make_step_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     return make_tick_fn(cfg)
 
 
+def make_heal_fn(cfg: Config):
+    """Single-device ring-engine overlay healing (None when -overlay-heal
+    is off, keeping the traced window untouched): once per poll window,
+    condemn dead friends (scenario.detect_dead), replace them via the
+    phase-1 makeup draw and deposit the infected healers' re-sends into
+    the delay ring like any broadcast (scenario.heal_and_wave)."""
+    if not cfg.overlay_heal_resolved:
+        return None
+    detect = cfg.heal_detect_ms
+    d = ring_depth(cfg)
+
+    def heal_fn(st: SimState, base_key: jax.Array) -> SimState:
+        n, k = st.friends.shape
+        ids = jnp.arange(n, dtype=I32)
+        detected = _scen.detect_dead(st.crashed, st.down_since, st.tick,
+                                     detect)
+        healer_ok = ~st.crashed
+        sender_inf = st.received & ~st.crashed & ~st.removed
+        bits = _scen.heal_peer_bits(detected, sender_inf)
+        friends, resend, pull, delay, clear, rep, blk = _scen.heal_and_wave(
+            cfg, st.friends, st.friend_cnt, bits, healer_ok, sender_inf,
+            _scen.rejoined_mask(st.down_since), ids, st.tick, base_key)
+        if cfg.effective_time_mode == "rounds":
+            dslot = jnp.broadcast_to((st.tick + 1) % d, (n,)).astype(I32)
+        else:
+            dslot = ((st.tick + delay) % d).astype(I32)
+        slots = jnp.broadcast_to(dslot[:, None], (n, k)).reshape(-1)
+        dst = jnp.where(resend, friends, -1).reshape(-1)
+        pending = deposit_local(st.pending, dst, slots, resend.reshape(-1))
+        # Rejoin pull responses deliver to the puller's OWN row.
+        pdst = jnp.broadcast_to(ids[:, None], (n, k)).reshape(-1)
+        pending = deposit_local(pending, pdst, slots, pull.reshape(-1))
+        return st._replace(
+            friends=friends, pending=pending,
+            down_since=jnp.where(clear, -1, st.down_since),
+            heal_repaired=st.heal_repaired + rep,
+            part_dropped=st.part_dropped + blk)
+
+    return heal_fn
+
+
 def make_window_fn(cfg: Config, window: int):
     """`window` consecutive steps as one device call (one progress window).
     The state is donated: the pending ring mutates in place instead of
     costing a fresh HBM allocation + copy per window (essential at 100M,
-    where two ring copies would not fit)."""
+    where two ring copies would not fit).  With -overlay-heal on, the
+    healing pass runs once at the end of every window -- the same cadence
+    (and tick keys) the fast-path loop heals at, so both paths walk one
+    trajectory."""
     step = make_step_fn(cfg)
+    heal = make_heal_fn(cfg)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def window_fn(st: SimState, base_key: jax.Array) -> SimState:
-        return jax.lax.fori_loop(0, window, lambda _, s: step(s, base_key), st)
+        st = jax.lax.fori_loop(0, window, lambda _, s: step(s, base_key), st)
+        if heal is not None:
+            st = heal(st, base_key)
+        return st
 
     return window_fn
 
@@ -526,11 +652,17 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     observes, without its per-window host round-trip; the signature becomes
     `run_fn(st, key, target, until, hist) -> (st, hist)`."""
     step = make_step_fn(cfg)
+    heal = make_heal_fn(cfg)
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
     # Push-pull draws fresh random peers each round -- there is no ring
-    # occupancy to test, and the wave never "dies in flight".
-    check_in_flight = cfg.protocol != "pushpull"
+    # occupancy to test, and the wave never "dies in flight".  Healing can
+    # REVIVE an empty ring (a pending dead-friend detection re-sends from
+    # an already-infected healer), so heal-on runs drop the early-death
+    # exit and run to target/max_rounds (same gate in the host exhaustion
+    # checks -- backends set `exhausted` only with healing off).
+    check_in_flight = (cfg.protocol != "pushpull"
+                       and not cfg.overlay_heal_resolved)
 
     def cond_live(s: SimState, target_count, until):
         live = ((s.total_received < target_count)
@@ -547,7 +679,10 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     def run_window(s: SimState, base_key):
         # One window per iteration keeps the predicate check off the
         # per-tick critical path.
-        return jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), s)
+        s = jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), s)
+        if heal is not None:
+            s = heal(s, base_key)
+        return s
 
     if telemetry:
         from gossip_simulator_tpu.utils import telemetry as telem

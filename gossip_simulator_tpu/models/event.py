@@ -71,6 +71,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from gossip_simulator_tpu import scenario as _scen
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic
 # in_flight: canonical engine-agnostic definition in models/state.py,
@@ -124,6 +125,12 @@ class EventState(NamedTuple):
     mail_dropped: jnp.ndarray  # int32[]  slot-capacity overflow (counted)
     # Cross-shard all_to_all bucket overflow (always 0 on one device).
     exchange_overflow: jnp.ndarray  # int32[]
+    # --- fault-injection scenario (scenario.py; see SimState) ------------
+    down_since: jnp.ndarray  # int32[n | 1]  crash tick, -1 = live/unknown
+    scen_crashed: jnp.ndarray  # int32[]
+    scen_recovered: jnp.ndarray  # int32[]
+    part_dropped: jnp.ndarray  # int32[]
+    heal_repaired: jnp.ndarray  # int32[]
 
 
 def batch_ticks(cfg: Config, n_local: int | None = None) -> int:
@@ -293,6 +300,9 @@ def init_state(cfg: Config, friends: jnp.ndarray,
         tick=z(), total_message=msg64_zero(), total_received=z(),
         total_crashed=z(),
         mail_dropped=z(), exchange_overflow=z(),
+        down_since=_scen.init_down_since(cfg.faults_enabled, n),
+        scen_crashed=z(), scen_recovered=z(), part_dropped=z(),
+        heal_repaired=z(),
     )
 
 
@@ -308,7 +318,7 @@ def _sender_keys(base_key, op: int, ticks, rows):
 
 def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
                     svalid, sticks, friends, friend_cnt, base_key,
-                    strig=None, flags=None):
+                    strig=None, flags=None, gid0=0):
     """Emit each sender's broadcast (k sends, ONE shared delay drawn at its
     delivery tick -- simulator.go:141-142) into the packed mail ring.
 
@@ -371,6 +381,18 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     wslot = (arrive // b) % dw
     off = arrive % b
     edge = svalid[:, None] & ~drop & (sf >= 0)
+    scen = cfg.scenario_resolved
+    blocked_n = 0
+    if scen.has_partitions:
+        # Send-time partition mask (scenario.partition_blocked): an edge
+        # whose broadcast leaves inside an active partition never enters
+        # the ring -- before the duplicate filter, so a blocked edge is
+        # never credited as a delivered duplicate.  `gid0` globalizes the
+        # sharded caller's local rows; sf destinations are global already.
+        blocked = _scen.partition_blocked(
+            scen, cfg.n, sticks[:, None], (gid0 + rows)[:, None], sf) & edge
+        blocked_n = blocked.sum(dtype=I32)
+        edge = edge & ~blocked
     dcnt = None
     if flags is not None:
         dstf = flags.at[jnp.where(sf >= 0, sf, 0)].get()
@@ -432,8 +454,9 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     # than the per-message count suggests.  slot_cap budgets mean_degree+1
     # per sender precisely so this stays at zero; a nonzero mail_dropped
     # under SIR should be treated as an undersized -event-slot-cap, not as
-    # ordinary message loss (see README divergence table).
-    return mail_ids, new_cnt, dropped + lost, sup_adds
+    # ordinary message loss (see README divergence table).  blocked_n is
+    # the partition-masked edge count (a Python 0 without partitions).
+    return mail_ids, new_cnt, dropped + lost, sup_adds, blocked_n
 
 
 # Pre-drain compaction engages only once received-fraction crosses this
@@ -494,7 +517,9 @@ def predrain_compact(b: int, n_rows: int, dw: int, cap: int, ccap: int,
 
 
 def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
-                     evalid, entry_pos, ckey, sir: bool = False):
+                     evalid, entry_pos, ckey, sir: bool = False,
+                     track_crashed: bool = False, down_since=None,
+                     win_tick=None):
     """Crash/infect/dedupe one drained chunk of packed entries (shared by the
     single-device and sharded engines; `n_rows` is the local row count).
 
@@ -520,9 +545,17 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     draws fire on data receptions only; removal draws happen in the caller
     (per sender, at send time, matching tick_core's removal-after-send).
 
-    Returns (flags, dm, dr, dc, ids_s, toff_s, senders); senders is
-    newly-infected for SI, newly | firing for SIR (disjoint: a trigger
-    implies the node was already infected)."""
+    `track_crashed` forces the pre-crash flag read even at crash_p == 0:
+    under a fault scenario, nodes crash OUTSIDE the per-reception draw
+    (crash waves / churn), and deliveries to them must still black-hole
+    (counted like the ring engine's `where(crashed, 0, arrivals)`).
+    `down_since`/`win_tick` non-None stamp the crash clock on reception
+    crashes (the scenario reboot/detection timeline; window-start
+    granularity -- the crash draw itself is window-batched already).
+
+    Returns (flags, dm, dr, dc, ids_s, toff_s, senders, down_since);
+    senders is newly-infected for SI, newly | firing for SIR (disjoint: a
+    trigger implies the node was already infected)."""
     ccap = packed.shape[0]
     tb = trigger_base(n_rows, b)
     sentinel = tb + n_rows * b if sir else n_rows * b
@@ -567,7 +600,7 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     idx = jnp.where(touched, ids_s, n_rows)
     pre = flags.at[idx].get(indices_are_sorted=srt, mode="clip")
     pre_recv = (pre & RECEIVED) > 0
-    if crash_p > 0.0:
+    if crash_p > 0.0 or track_crashed:
         pre_crash = ((pre & CRASHED) > 0) & touched
     else:
         pre_crash = jnp.zeros((ccap,), bool)
@@ -583,6 +616,10 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
         run_crash = first & crash_s & ~pre_crash
         dc = run_crash.sum(dtype=I32)
         delta = delta + run_crash.astype(jnp.uint8) * CRASHED
+        if down_since is not None:
+            down_since = down_since.at[
+                jnp.where(run_crash, ids_s, n_rows)].set(
+                win_tick, mode="drop")
     # (No sorted claim here: non-winning lanes divert to n_rows BETWEEN
     # the ascending winners, breaking monotonicity.)
     flags = flags.at[jnp.where(delta > 0, ids_s, n_rows)].add(
@@ -591,7 +628,7 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     if sir:
         fire = is_trig & pre_recv & ~pre_crash & ~((pre & REMOVED) > 0)
         senders = newly | fire
-    return flags, dm, dr, dc, ids_s, toff_s, senders
+    return flags, dm, dr, dc, ids_s, toff_s, senders, down_since
 
 
 def sender_compaction_cap(cfg: Config, ccap: int) -> int:
@@ -720,11 +757,38 @@ def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb,
     return sids, stoff, svalid
 
 
+def apply_fault_window_flags(cfg: Config, flags, down_since, tick,
+                             ids_global, base_key, nticks: int):
+    """Event-engine adapter for scenario.fault_window: the crashed mask
+    lives in flags bit1.  Applied at window start (the window's drain then
+    black-holes deliveries to freshly crashed nodes, the event analog of
+    the ring engine's per-tick `where(crashed, 0, arrivals)`).  Recovery
+    clears ONLY the crashed bit: a recovered node keeps its received (and
+    SIR removed) history.  Returns (flags, down_since, d_crash,
+    d_recover); a no-op with Python-zero deltas when the scenario has no
+    fault events."""
+    scen = cfg.scenario_resolved
+    if not scen.has_faults:
+        return flags, down_since, 0, 0
+    crashed = (flags & CRASHED) > 0
+    new_crash, recover, down, dc, drc = _scen.fault_window(
+        scen, cfg.n, tick, nticks, ids_global, crashed, down_since,
+        base_key)
+    flags = jnp.where(recover, flags & ~CRASHED, flags)
+    flags = jnp.where(new_crash, flags | CRASHED, flags)
+    return flags, down, dc, drc
+
+
 def make_window_step_fn(cfg: Config, n_local: int | None = None):
     """One B-tick window transition: drain this window's packed list in
     chunks (drain_chunk_core), and emit the newly infected nodes' broadcasts
     at their actual delivery ticks.  SIR adds re-broadcast triggers and
-    per-sender removal draws (drain_chunk_core with sir=True)."""
+    per-sender removal draws (drain_chunk_core with sir=True).
+
+    Scenario faults (crash waves / churn / recovery) apply at window
+    start; partition masks filter every append at send time.  With
+    -scenario off and -overlay-heal off every gate below is Python-False
+    and the traced program is the pre-scenario one, byte for byte."""
     b = batch_ticks(cfg)
     dw = ring_windows(cfg)
     ccap = drain_chunk(cfg, n_local)
@@ -737,6 +801,15 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
     # resolved gate implies crash_p == 0 (config.validate rejects "on"
     # otherwise), so the per-reception draw stream it would shift is empty.
     suppress = cfg.dup_suppress_resolved
+    scen = cfg.scenario_resolved
+    faults = cfg.faults_enabled
+    # Scenario gates: the drain must honor crashed bits even at
+    # crash_p == 0 once faults can set them; the crash clock is carried
+    # only when reception crashes can stamp it; the partition counter is
+    # carried only when partitions exist.
+    track_crashed = faults or scen.has_faults
+    track_down = faults and crash_p > 0.0
+    track_part = scen.has_partitions
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.flags.shape[0]
@@ -745,6 +818,10 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
         m = st.mail_cnt[0, slot]
         dm0 = st.sup_cnt[0, slot]
         mail0 = st.mail_ids
+        flags1, down1, dsc, dsr = apply_fault_window_flags(
+            cfg, st.flags, st.down_since, st.tick,
+            jnp.arange(n, dtype=I32), base_key, b)
+        st = st._replace(flags=flags1, down_since=down1)
         if suppress:
             # Pre-drain compaction: duplicates that slipped past the
             # append-side filter die here, before the sorted drain pays
@@ -762,18 +839,41 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
         chunks = (m + ccap - 1) // ccap
         ckey = _rng.tick_key(base_key, w, _rng.OP_CRASH)
 
+        # Conditional loop-carry tail: the crash clock rides the chunk
+        # loop only when reception crashes can stamp it, the partition
+        # counter only when partitions exist -- the scenario-off carry is
+        # the pre-scenario tuple exactly.
+        def pack(core, down, part):
+            c = list(core)
+            if track_down:
+                c.append(down)
+            if track_part:
+                c.append(part)
+            return tuple(c)
+
+        def unpack(c):
+            core, i = c[:8], 8
+            down = part = None
+            if track_down:
+                down, i = c[i], i + 1
+            if track_part:
+                part = c[i]
+            return core, down, part
+
         def body(j, carry):
             (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
-             dropped) = carry
+             dropped), down, part = unpack(carry)
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
             cap = (mail_ids.shape[0] - tail) // dw
             packed = jax.lax.dynamic_slice(
                 mail_ids, (slot * cap + off0,), (ccap,))
-            flags, cdm, cdr, cdc, ids_s, toff_s, senders = \
+            flags, cdm, cdr, cdc, ids_s, toff_s, senders, down = \
                 drain_chunk_core(crash_p, b, n, flags, packed, evalid,
-                                 entry_pos, ckey, sir=sir)
+                                 entry_pos, ckey, sir=sir,
+                                 track_crashed=track_crashed,
+                                 down_since=down, win_tick=st.tick)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
             if scap:
                 # Compact senders to <=scap-row batches (sender_batch),
@@ -786,8 +886,13 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
 
                 def make_abody(width, lo_of):
                     def abody(jb, acarry):
-                        (aflags, amail_ids, amail_cnt, asup,
-                         adropped) = acarry
+                        if track_part:
+                            (aflags, amail_ids, amail_cnt, asup,
+                             adropped, apart) = acarry
+                        else:
+                            (aflags, amail_ids, amail_cnt, asup,
+                             adropped) = acarry
+                            apart = None
                         sids, stoff, svalid = sender_batch(
                             senders, srank, scnt, spacked, b, width, jb,
                             lo=lo_of(jb))
@@ -810,25 +915,31 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                                 jnp.where(rem, sids, n)].add(
                                 REMOVED, mode="drop")
                             strig = svalid & ~rem
-                        amail_ids, amail_cnt, adropped, sa = append_messages(
+                        (amail_ids, amail_cnt, adropped, sa,
+                         ablk) = append_messages(
                             cfg, amail_ids, amail_cnt, adropped, sids,
                             svalid, stick2, st.friends, st.friend_cnt,
                             base_key, strig=strig,
                             flags=aflags if suppress else None)
-                        return (aflags, amail_ids, amail_cnt,
-                                asup + sa[None, :], adropped)
+                        out = (aflags, amail_ids, amail_cnt,
+                               asup + sa[None, :], adropped)
+                        if track_part:
+                            out = out + (apart + ablk,)
+                        return out
                     return abody
 
                 # Small remainders run as 1-2 narrow batches at ~op-floor
                 # cost instead of one element-bound full-width batch
                 # (narrow_tail_cap's rationale; run_narrow_tail drives).
-                (flags, mail_ids, mail_cnt, sup_cnt,
-                 dropped) = run_narrow_tail(
-                    make_abody,
-                    (flags, mail_ids, mail_cnt, sup_cnt, dropped),
-                    scnt, scap)
-                return (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
-                        dropped)
+                acarry0 = (flags, mail_ids, mail_cnt, sup_cnt, dropped)
+                if track_part:
+                    acarry0 = acarry0 + (part,)
+                out = run_narrow_tail(make_abody, acarry0, scnt, scap)
+                (flags, mail_ids, mail_cnt, sup_cnt, dropped) = out[:5]
+                if track_part:
+                    part = out[5]
+                return pack((flags, mail_ids, mail_cnt, sup_cnt, dm, dr,
+                             dc, dropped), down, part)
             sticks = w * b + toff_s
             strig = None
             if sir:
@@ -852,13 +963,15 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
             # ~6-10% SLOWER at n=1e7/1e8 fanout 3 -- the 5-op selection
             # cost more than the 2.4x width saving; the 2-op rank-scatter
             # compaction above pays only at higher degree.)
-            mail_ids, mail_cnt, dropped, sa = append_messages(
+            mail_ids, mail_cnt, dropped, sa, blk = append_messages(
                 cfg, mail_ids, mail_cnt, dropped,
                 jnp.where(senders, ids_s, 0), senders, sticks,
                 st.friends, st.friend_cnt, base_key, strig=strig,
                 flags=flags if suppress else None)
-            return (flags, mail_ids, mail_cnt, sup_cnt + sa[None, :],
-                    dm, dr, dc, dropped)
+            if track_part:
+                part = part + blk
+            return pack((flags, mail_ids, mail_cnt, sup_cnt + sa[None, :],
+                         dm, dr, dc, dropped), down, part)
 
         z = jnp.zeros((), I32)
         # Credit this window's deferred duplicate counts (banked by
@@ -866,20 +979,30 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
         # would have counted; appends during this drain only target later
         # windows (delay >= B), so the slot accrues nothing new before the
         # zeroing below.
-        (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
-         dropped) = jax.lax.fori_loop(
+        out = jax.lax.fori_loop(
             0, chunks, body,
-            (st.flags, mail0, st.mail_cnt, st.sup_cnt,
-             dm0, z, z, st.mail_dropped))
+            pack((st.flags, mail0, st.mail_cnt, st.sup_cnt,
+                  dm0, z, z, st.mail_dropped), st.down_since, z))
+        (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
+         dropped), down, part = unpack(out)
         mail_cnt = mail_cnt.at[0, slot].set(0)
         sup_cnt = sup_cnt.at[0, slot].set(0)
-        return st._replace(
+        st = st._replace(
             flags=flags, mail_ids=mail_ids,
             mail_cnt=mail_cnt, sup_cnt=sup_cnt, tick=st.tick + b,
             total_message=msg64_add(st.total_message, dm),
             total_received=st.total_received + dr,
             total_crashed=st.total_crashed + dc,
             mail_dropped=dropped)
+        if track_down:
+            st = st._replace(down_since=down)
+        if scen.active:
+            st = st._replace(
+                scen_crashed=st.scen_crashed + dsc,
+                scen_recovered=st.scen_recovered + dsr)
+        if track_part:
+            st = st._replace(part_dropped=st.part_dropped + part)
+        return st
 
     return step_fn
 
@@ -918,6 +1041,13 @@ def make_seed_fn(cfg: Config):
         arrive = st.tick + delay
         wslot = (arrive // b) % dw
         edge = (jnp.arange(k, dtype=I32) < scnt) & ~drop & (sf >= 0)
+        scen = cfg.scenario_resolved
+        if scen.has_partitions:
+            blocked = _scen.partition_blocked(
+                scen, cfg.n, st.tick, sender, sf) & edge
+            st = st._replace(
+                part_dropped=st.part_dropped + blocked.sum(dtype=I32))
+            edge = edge & ~blocked
         payload = sf * b + arrive % b
         cols = jnp.cumsum(edge, dtype=I32) - 1  # exact-size, like append
         ec = edge.sum(dtype=I32)
@@ -949,15 +1079,71 @@ def make_seed_fn(cfg: Config):
     return seed_fn
 
 
+def make_heal_fn(cfg: Config, n_local: int | None = None):
+    """Single-device event-engine overlay healing (None when off): condemn
+    dead friends (scenario.detect_dead), replace them via the phase-1
+    makeup draw, and append the infected healers' re-sends into the mail
+    ring at their drawn arrival ticks (scenario.heal_and_wave)."""
+    if not cfg.overlay_heal_resolved:
+        return None
+    from gossip_simulator_tpu.ops.mailbox import ring_append
+
+    b = batch_ticks(cfg, n_local)
+    dw = ring_windows(cfg, n_local)
+    detect = cfg.heal_detect_ms
+
+    def heal_fn(st: EventState, base_key: jax.Array) -> EventState:
+        n, k = st.friends.shape
+        ids = jnp.arange(n, dtype=I32)
+        crashed = (st.flags & CRASHED) > 0
+        detected = _scen.detect_dead(crashed, st.down_since, st.tick,
+                                     detect)
+        healer_ok = ~crashed
+        sender_inf = ((st.flags & RECEIVED) > 0) & ~crashed \
+            & ~((st.flags & REMOVED) > 0)
+        bits = _scen.heal_peer_bits(detected, sender_inf)
+        friends, resend, pull, delay, clear, rep, blk = _scen.heal_and_wave(
+            cfg, st.friends, st.friend_cnt, bits, healer_ok, sender_inf,
+            _scen.rejoined_mask(st.down_since), ids, st.tick, base_key)
+        arrive = st.tick + delay  # per healer row (shared across its lanes)
+        wslot = jnp.broadcast_to(((arrive // b) % dw)[:, None],
+                                 (n, k)).reshape(-1)
+        off = (arrive % b)[:, None]
+        payload = (friends * b + off).reshape(-1)
+        cap = (st.mail_ids.shape[0] - ring_tail(cfg, n_local)) // dw
+        (mail,), cnt, dropped = ring_append(
+            (st.mail_ids,), st.mail_cnt, st.mail_dropped, (payload,),
+            wslot, resend.reshape(-1), dw, cap)
+        # Rejoin pull responses deliver to the puller's OWN row.
+        ppay = jnp.broadcast_to((ids * b)[:, None] + off, (n, k)).reshape(-1)
+        (mail,), cnt, dropped = ring_append(
+            (mail,), cnt, dropped, (ppay,), wslot, pull.reshape(-1), dw,
+            cap)
+        return st._replace(
+            friends=friends, mail_ids=mail, mail_cnt=cnt,
+            mail_dropped=dropped,
+            down_since=jnp.where(clear, -1, st.down_since),
+            heal_repaired=st.heal_repaired + rep,
+            part_dropped=st.part_dropped + blk)
+
+    return heal_fn
+
+
 def make_window_fn(cfg: Config, window: int):
     """Advance ~`window` simulated ms as one device call (the driver's poll
-    cadence): ceil(window / B) batched window steps."""
+    cadence): ceil(window / B) batched window steps, then -- with
+    -overlay-heal on -- one healing pass (the same cadence and tick keys
+    the fast-path loop heals at)."""
     step = make_window_step_fn(cfg)
+    heal = make_heal_fn(cfg)
     steps = max(1, -(-window // batch_ticks(cfg)))
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def window_fn(st: EventState, base_key: jax.Array) -> EventState:
-        return jax.lax.fori_loop(0, steps, lambda _, s: step(s, base_key), st)
+        st = jax.lax.fori_loop(0, steps, lambda _, s: step(s, base_key), st)
+        if heal is not None:
+            st = heal(st, base_key)
+        return st
 
     return window_fn
 
@@ -979,20 +1165,30 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     one counters row per poll window (signature gains a `hist` argument and
     the return becomes `(st, hist)`)."""
     step = make_window_step_fn(cfg)
+    heal = make_heal_fn(cfg)
     max_steps = cfg.max_rounds
     steps = poll_window_steps(cfg)
+    # Healing can revive an empty ring (pending dead-friend detections
+    # re-send from infected healers), so heal-on runs drop the early-death
+    # exit (see epidemic.make_run_to_coverage_fn).
+    check_in_flight = not cfg.overlay_heal_resolved
 
     def cond_live(s: EventState, target_count, until):
         # The in-flight term (a dw-element emptiness test -- free) stops
         # the loop the moment the wave dies instead of spinning empty
         # windows to max_rounds (the host-side exhaustion check only
         # runs between bounded calls).
-        return ((s.total_received < target_count)
-                & (s.tick < max_steps) & (s.tick < until)
-                & (in_flight(s) > 0))
+        live = ((s.total_received < target_count)
+                & (s.tick < max_steps) & (s.tick < until))
+        if check_in_flight:
+            live = live & (in_flight(s) > 0)
+        return live
 
     def run_window(s: EventState, base_key):
-        return jax.lax.fori_loop(0, steps, lambda _, x: step(x, base_key), s)
+        s = jax.lax.fori_loop(0, steps, lambda _, x: step(x, base_key), s)
+        if heal is not None:
+            s = heal(s, base_key)
+        return s
 
     if telemetry:
         from gossip_simulator_tpu.utils import telemetry as telem

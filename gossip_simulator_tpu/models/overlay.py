@@ -328,6 +328,35 @@ def process_makeup_slot(fanin, friends, cnt, src, has, kk):
     return friends, cnt, victim, ev
 
 
+def heal_dead_friends(n_global: int, friends, friend_cnt, detected_global,
+                      healer_ok, ids_global, heal_key):
+    """Phase-2 re-entry of the bootstrap/needNewFriend draw
+    (simulator.go:95-106): every live node replaces friends its failure
+    detector has condemned with a fresh uniform random peer, self patched
+    ``(id+1) % N`` exactly like the phase-1 bootstrap.  Vectorized over
+    the whole (n, k) table at once -- the overlay's makeup *decision* is
+    what re-runs here; the reciprocal fanin-side accept (the target
+    adding the healer back, simulator.go:66-75) is not simulated, a
+    documented divergence (README "Fault model & scenarios").
+
+    `detected_global` is the full-axis bool[n_global] detector verdict
+    (the sharded caller all_gathers its local verdicts first); draws are
+    row-keyed on GLOBAL ids, so a shard's slice heals bit-identically to
+    the single-device run.  The fresh draw is uniform and may itself land
+    on a dead node (the reference's draws have no global liveness oracle
+    either); a dead pick is condemned again next detection window.
+    Returns (friends', dead_mask, repaired_count_local)."""
+    k = friends.shape[1]
+    in_range = jnp.arange(k, dtype=I32)[None, :] < friend_cnt[:, None]
+    valid = in_range & (friends >= 0)
+    dead = detected_global.at[jnp.maximum(friends, 0)].get() \
+        & valid & healer_ok[:, None]
+    w = _rng.row_randint(heal_key, n_global, ids_global, k)
+    w = jnp.where(w == ids_global[:, None], (w + 1) % n_global, w)
+    friends = jnp.where(dead, w, friends)
+    return friends, dead, dead.sum(dtype=I32)
+
+
 def make_round_fn(cfg: Config,
                   deliver_fn=None,
                   ids_fn=None,

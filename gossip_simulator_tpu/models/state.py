@@ -70,6 +70,16 @@ class SimState(NamedTuple):
     # Framework-only: cross-shard all_to_all bucket overflow (0 on one chip;
     # counted, never silently lost -- SURVEY §7.3 hard part #4).
     exchange_overflow: jnp.ndarray  # int32[]
+    # --- fault-injection scenario (scenario.py) --------------------------
+    # Crash tick per node (-1 = live / unknown): the recovery clock and the
+    # healer's dead-friend detection window.  Full (n,) only when the
+    # fault machinery is on (Config.faults_enabled); a 1-element
+    # placeholder otherwise, so fault-free runs pay nothing.
+    down_since: jnp.ndarray  # int32[n | 1]
+    scen_crashed: jnp.ndarray  # int32[]  scenario-crashed (waves + churn)
+    scen_recovered: jnp.ndarray  # int32[]  nodes rebooted after downtime
+    part_dropped: jnp.ndarray  # int32[]  sends black-holed by partitions
+    heal_repaired: jnp.ndarray  # int32[]  dead friend edges replaced
 
 
 def in_flight(st) -> jnp.ndarray:

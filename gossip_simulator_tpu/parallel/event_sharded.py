@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from gossip_simulator_tpu import scenario as _scen
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic, event, graphs
 from gossip_simulator_tpu.models.event import EventState
@@ -74,13 +75,18 @@ PRE_EXCHANGE_SUPPRESS = True   # filter local-dest duplicates before routing
 DIRECT_SELF_APPEND = True      # S=1: skip the route (it is the identity)
 
 
-def event_state_specs() -> EventState:
+def event_state_specs(cfg: Config) -> EventState:
+    # down_since: see sharded_step.sim_state_specs -- node-sharded only
+    # when the fault machinery allocates the full axis.
     return EventState(
         flags=P(AXIS),
         friends=P(AXIS, None), friend_cnt=P(AXIS),
         mail_ids=P(AXIS), mail_cnt=P(AXIS, None), sup_cnt=P(AXIS, None),
         tick=P(), total_message=P(), total_received=P(), total_crashed=P(),
         mail_dropped=P(), exchange_overflow=P(),
+        down_since=P(AXIS) if cfg.faults_enabled else P(),
+        scen_crashed=P(), scen_recovered=P(), part_dropped=P(),
+        heal_repaired=P(),
     )
 
 
@@ -103,7 +109,7 @@ def make_sharded_event_init(cfg: Config, mesh):
         return event.init_state(cfg, friends, cnt)
 
     return jax.jit(_shard_map(mesh, init_shard, in_specs=(),
-                              out_specs=event_state_specs()))
+                              out_specs=event_state_specs(cfg)))
 
 
 def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
@@ -257,9 +263,23 @@ def make_sharded_event_step(cfg: Config, mesh):
     def wire_cap(m_edges: int) -> int:
         return exchange.chernoff_cap(m_edges, s) if uniform_dest else m_edges
 
+    scen = cfg.scenario_resolved
+    faults = cfg.faults_enabled
+    track_crashed = faults or scen.has_faults
+    track_down = faults and crash_p > 0.0
+    track_part = scen.has_partitions
+
     def step_shard(st: EventState, base_key: jax.Array) -> EventState:
         shard = jax.lax.axis_index(AXIS)
         skey = jax.random.fold_in(base_key, shard)
+        gid0 = shard * n_local
+        # Scenario faults: (window, GLOBAL-id)-keyed draws -- the one
+        # stream in this engine NOT shard-folded, so the crash/recovery
+        # schedule is identical at any shard count (reshard-resume safe).
+        flags1, down1, dsc, dsr = event.apply_fault_window_flags(
+            cfg, st.flags, st.down_since, st.tick,
+            gid0 + jnp.arange(n_local, dtype=I32), base_key, b)
+        st = st._replace(flags=flags1, down_since=down1)
         w = st.tick // b
         slot = w % dw
         m = st.mail_cnt[0, slot]
@@ -299,7 +319,9 @@ def make_sharded_event_step(cfg: Config, mesh):
             """Route one batch of senders' broadcasts (delay/drop draws,
             SIR removal + local triggers, all_to_all + ring append) at a
             static `width`.  Keys are shard-folded + (tick, local-row)
-            keyed, so the draws do not depend on the batch width."""
+            keyed, so the draws do not depend on the batch width.
+            Returns a trailing partition-block count (Python 0 without
+            partitions)."""
             if s == 1 and DIRECT_SELF_APPEND and not sir:
                 # One-device SI mesh: the emission IS the single-device
                 # append -- append_messages draws the identical
@@ -313,12 +335,13 @@ def make_sharded_event_step(cfg: Config, mesh):
                 # routed form appends batch triggers AFTER batch data,
                 # while append_messages interleaves each sender's trigger
                 # with its edges -- a different (established, pre-round-6)
-                # ring order this rework must not shift.
-                mail, cnt, dropped, sa = event.append_messages(
+                # ring order this rework must not shift.  The partition
+                # mask applies inside append_messages (gid0 globalizes).
+                mail, cnt, dropped, sa, blk = event.append_messages(
                     cfg, mail, cnt, dropped, sids, svalid, sticks,
                     st.friends, st.friend_cnt, skey,
-                    flags=flags if suppress else None)
-                return flags, mail, cnt, dropped, xovf, sa
+                    flags=flags if suppress else None, gid0=gid0)
+                return flags, mail, cnt, dropped, xovf, sa, blk
             rows = jnp.where(svalid, sids, n_local)
             sidx = jnp.where(svalid, sids, 0)
             sf = st.friends.at[sidx].get()
@@ -353,6 +376,16 @@ def make_sharded_event_step(cfg: Config, mesh):
                 flags = flags.at[jnp.where(rem, sids, n_local)].add(
                     event.REMOVED, mode="drop")
             edge = svalid[:, None] & ~drop & (sf >= 0)
+            blk = 0
+            if track_part:
+                # Send-time partition mask on global (src, dst) ids --
+                # before the route AND before the duplicate filter, so a
+                # blocked edge is never credited as a delivered duplicate.
+                blocked = _scen.partition_blocked(
+                    scen, cfg.n, sticks[:, None], (gid0 + rows)[:, None],
+                    sf) & edge
+                blk = blocked.sum(dtype=I32)
+                edge = edge & ~blocked
             dstg = jnp.where(edge, sf, 0).reshape(-1)
             mail, cnt, dropped, xovf, nsup = _route_and_append(
                 cfg, s, n_local, mail, cnt, dropped, xovf, dstg,
@@ -365,19 +398,43 @@ def make_sharded_event_step(cfg: Config, mesh):
                 mail, cnt, dropped = _append_local_triggers(
                     cfg, n_local, mail, cnt, dropped, rows, svalid & ~rem,
                     wslot2, off2)
-            return flags, mail, cnt, dropped, xovf, nsup
+            return flags, mail, cnt, dropped, xovf, nsup, blk
+
+        # Conditional loop-carry tail, mirroring the single-device step:
+        # crash clock only when reception crashes stamp it, partition
+        # counter only when partitions exist -- the scenario-off carry is
+        # the pre-scenario tuple exactly.
+        def pack(core, down, part):
+            c = list(core)
+            if track_down:
+                c.append(down)
+            if track_part:
+                c.append(part)
+            return tuple(c)
+
+        def unpack(c):
+            core, i = c[:9], 9
+            down = part = None
+            if track_down:
+                down, i = c[i], i + 1
+            if track_part:
+                part = c[i]
+            return core, down, part
 
         def body(j, carry):
-            (flags, mail, cnt, sup, dm, dr, dc, dropped, xovf) = carry
+            (flags, mail, cnt, sup, dm, dr, dc, dropped,
+             xovf), down, part = unpack(carry)
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
             packed = jax.lax.dynamic_slice(mail, (slot * cap + off0,),
                                            (ccap,))
-            flags, cdm, cdr, cdc, ids_s, toff_s, senders = \
+            flags, cdm, cdr, cdc, ids_s, toff_s, senders, down = \
                 event.drain_chunk_core(crash_p, b, n_local, flags,
                                        packed, evalid, entry_pos,
-                                       ckey, sir=sir)
+                                       ckey, sir=sir,
+                                       track_crashed=track_crashed,
+                                       down_since=down, win_tick=st.tick)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
             if scap:
                 # Sender compaction (see the single-device step's
@@ -398,46 +455,63 @@ def make_sharded_event_step(cfg: Config, mesh):
                     # width * kwidth: zero-loss per-pair receive buffer
                     # at this batch width (see the step-level comment).
                     def abody(jb, acarry):
-                        aflags, amail, acnt, asup, adropped, axovf = acarry
+                        if track_part:
+                            (aflags, amail, acnt, asup, adropped, axovf,
+                             apart) = acarry
+                        else:
+                            (aflags, amail, acnt, asup, adropped,
+                             axovf) = acarry
+                            apart = None
                         bids, btoff, bvalid = event.sender_batch(
                             senders, srank, scnt, spacked, b, width, jb,
                             lo=lo_of(jb))
-                        (aflags, amail, acnt, adropped, axovf,
-                         sa) = emit(aflags, amail, acnt, adropped, axovf,
-                                    bids, bvalid, w * b + btoff, width,
-                                    wire_cap(width * kwidth))
-                        return (aflags, amail, acnt, asup + sa[None, :],
-                                adropped, axovf)
+                        (aflags, amail, acnt, adropped, axovf, sa,
+                         ablk) = emit(aflags, amail, acnt, adropped,
+                                      axovf, bids, bvalid, w * b + btoff,
+                                      width, wire_cap(width * kwidth))
+                        out = (aflags, amail, acnt, asup + sa[None, :],
+                               adropped, axovf)
+                        if track_part:
+                            out = out + (apart + ablk,)
+                        return out
                     return abody
 
                 # Shared schedule + driver (event.run_narrow_tail) on the
                 # pmax-agreed smax, so every shard still runs the same
                 # number of all_to_alls.
-                (flags, mail, cnt, sup, dropped,
-                 xovf) = event.run_narrow_tail(
-                    make_abody,
-                    (flags, mail, cnt, sup, dropped, xovf), smax, scap)
+                acarry0 = (flags, mail, cnt, sup, dropped, xovf)
+                if track_part:
+                    acarry0 = acarry0 + (part,)
+                out = event.run_narrow_tail(make_abody, acarry0, smax,
+                                            scap)
+                (flags, mail, cnt, sup, dropped, xovf) = out[:6]
+                if track_part:
+                    part = out[6]
             else:
-                flags, mail, cnt, dropped, xovf, sa = emit(
+                flags, mail, cnt, dropped, xovf, sa, blk = emit(
                     flags, mail, cnt, dropped, xovf, ids_s, senders,
                     w * b + toff_s, ccap, rcap)
                 sup = sup + sa[None, :]
-            return (flags, mail, cnt, sup, dm, dr, dc, dropped, xovf)
+                if track_part:
+                    part = part + blk
+            return pack((flags, mail, cnt, sup, dm, dr, dc, dropped,
+                         xovf), down, part)
 
         z = jnp.zeros((), I32)
         # dm starts at this shard's deferred duplicate credits for the
         # draining window (banked by _route_and_append; appends during
         # this drain only target later windows), zeroed with mail_cnt.
-        (flags, mail, cnt, sup, dm, dr, dc, ddrop,
-         dxovf) = jax.lax.fori_loop(
+        out = jax.lax.fori_loop(
             0, chunks, body,
-            (st.flags, mail0, st.mail_cnt, st.sup_cnt,
-             dm0, z, z, z, z))
+            pack((st.flags, mail0, st.mail_cnt, st.sup_cnt,
+                  dm0, z, z, z, z), st.down_since, z))
+        (flags, mail, cnt, sup, dm, dr, dc, ddrop,
+         dxovf), down, part = unpack(out)
         cnt = cnt.at[0, slot].set(0)
         sup = sup.at[0, slot].set(0)
         dm, dr, dc, ddrop, dxovf = jax.lax.psum((dm, dr, dc, ddrop, dxovf),
                                                 AXIS)
-        return st._replace(
+        st = st._replace(
             flags=flags, mail_ids=mail, mail_cnt=cnt, sup_cnt=sup,
             tick=st.tick + b,
             total_message=msg64_add(st.total_message, dm),
@@ -445,6 +519,17 @@ def make_sharded_event_step(cfg: Config, mesh):
             total_crashed=st.total_crashed + dc,
             mail_dropped=st.mail_dropped + ddrop,
             exchange_overflow=st.exchange_overflow + dxovf)
+        if track_down:
+            st = st._replace(down_since=down)
+        if scen.active:
+            psc, psr = jax.lax.psum(
+                (jnp.asarray(dsc, I32), jnp.asarray(dsr, I32)), AXIS)
+            st = st._replace(scen_crashed=st.scen_crashed + psc,
+                             scen_recovered=st.scen_recovered + psr)
+        if track_part:
+            st = st._replace(
+                part_dropped=st.part_dropped + jax.lax.psum(part, AXIS))
+        return st
 
     return step_shard
 
@@ -477,6 +562,14 @@ def make_sharded_event_seed(cfg: Config, mesh):
         arrive = st.tick + delay
         edge = (jnp.arange(kwidth, dtype=I32) < scnt) & ~drop & (sf >= 0) \
             & own
+        scen = cfg.scenario_resolved
+        if scen.has_partitions:
+            blocked = _scen.partition_blocked(
+                scen, cfg.n, st.tick, sender, sf) & edge
+            st = st._replace(
+                part_dropped=st.part_dropped
+                + jax.lax.psum(blocked.sum(dtype=I32), AXIS))
+            edge = edge & ~blocked
         flags, total_received = st.flags, st.total_received
         if cfg.protocol == "sir" or not cfg.compat_reference:
             # SIR always marks the seed: trigger firing needs the received
@@ -515,21 +608,87 @@ def make_sharded_event_seed(cfg: Config, mesh):
     return seed_shard
 
 
+def make_sharded_event_heal(cfg: Config, mesh):
+    """Sharded event-engine overlay healing (shard_map body; None when
+    off): per-shard detector verdicts are all_gathered (one bool per
+    node), condemned friends are replaced via the GLOBAL-id-keyed makeup
+    draw, and infected healers' re-sends ride the normal all_to_all
+    route+append.  See sharded_step.make_sharded_heal for the ring
+    twin."""
+    if not cfg.overlay_heal_resolved:
+        return None
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+    b = event.batch_ticks(cfg)
+    dw = event.ring_windows(cfg)
+    detect = cfg.heal_detect_ms
+
+    def heal_shard(st: EventState, base_key: jax.Array) -> EventState:
+        shard = jax.lax.axis_index(AXIS)
+        gids = shard * n_local + jnp.arange(n_local, dtype=I32)
+        rows = jnp.arange(n_local, dtype=I32)
+        k = st.friends.shape[1]
+        crashed = (st.flags & event.CRASHED) > 0
+        detected = _scen.detect_dead(crashed, st.down_since, st.tick,
+                                     detect)
+        healer_ok = ~crashed
+        sender_inf = ((st.flags & event.RECEIVED) > 0) & ~crashed \
+            & ~((st.flags & event.REMOVED) > 0)
+        bits_global = jax.lax.all_gather(
+            _scen.heal_peer_bits(detected, sender_inf), AXIS, tiled=True)
+        friends, resend, pull, delay, clear, rep, blk = _scen.heal_and_wave(
+            cfg, st.friends, st.friend_cnt, bits_global, healer_ok,
+            sender_inf, _scen.rejoined_mask(st.down_since), gids, st.tick,
+            base_key)
+        arrive = st.tick + delay
+        wslot = jnp.broadcast_to(((arrive // b) % dw)[:, None],
+                                 (n_local, k)).reshape(-1)
+        off = jnp.broadcast_to((arrive % b)[:, None],
+                               (n_local, k)).reshape(-1)
+        rcap = min(exchange.epidemic_cap(n_local, k, s), n_local * k)
+        mail, cnt, dropped, xovf, _ = _route_and_append(
+            cfg, s, n_local, st.mail_ids, st.mail_cnt, jnp.zeros((), I32),
+            jnp.zeros((), I32), jnp.where(resend, friends, 0).reshape(-1),
+            wslot, off, resend.reshape(-1), rcap)
+        # Rejoin pull responses deliver to the puller's OWN row -- always
+        # shard-local, so they append directly.
+        ppay = jnp.broadcast_to(rows[:, None] * b,
+                                (n_local, k)).reshape(-1) + off
+        mail, cnt, dropped = _ring_append(
+            cfg, n_local, mail, cnt, dropped, ppay, wslot,
+            pull.reshape(-1))
+        rep, blk, dropped, xovf = jax.lax.psum(
+            (rep, jnp.asarray(blk, I32), dropped, xovf), AXIS)
+        return st._replace(
+            friends=friends, mail_ids=mail, mail_cnt=cnt,
+            mail_dropped=st.mail_dropped + dropped,
+            exchange_overflow=st.exchange_overflow + xovf,
+            down_since=jnp.where(clear, -1, st.down_since),
+            heal_repaired=st.heal_repaired + rep,
+            part_dropped=st.part_dropped + blk)
+
+    return heal_shard
+
+
 def make_window_fn(cfg: Config, mesh, window: int):
     """Advance ~`window` simulated ms as one device call."""
     step = make_sharded_event_step(cfg, mesh)
+    heal = make_sharded_event_heal(cfg, mesh)
     steps = max(1, -(-window // event.batch_ticks(cfg)))
-    specs = event_state_specs()
+    specs = event_state_specs(cfg)
 
     def window_shard(st: EventState, base_key: jax.Array) -> EventState:
-        return jax.lax.fori_loop(0, steps, lambda _, x: step(x, base_key), st)
+        st = jax.lax.fori_loop(0, steps, lambda _, x: step(x, base_key), st)
+        if heal is not None:
+            st = heal(st, base_key)
+        return st
 
     return jax.jit(_shard_map(mesh, window_shard, in_specs=(specs, P()),
                               out_specs=specs), donate_argnums=(0,))
 
 
 def make_seed_fn(cfg: Config, mesh):
-    specs = event_state_specs()
+    specs = event_state_specs(cfg)
     return jax.jit(_shard_map(mesh, make_sharded_event_seed(cfg, mesh),
                               in_specs=(specs, P()), out_specs=specs))
 
@@ -539,11 +698,15 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
     `telemetry`, carries the per-window History inside shard_map with
     replicated specs (see sharded_step.make_run_to_coverage_fn)."""
     step = make_sharded_event_step(cfg, mesh)
-    specs = event_state_specs()
+    heal = make_sharded_event_heal(cfg, mesh)
+    specs = event_state_specs(cfg)
     max_steps = cfg.max_rounds
     # One while iteration = one full 10 ms poll window, the cadence the
     # windowed driver path observes at (see event.poll_window_steps).
     steps = event.poll_window_steps(cfg)
+    # Heal-on runs drop the early-death exit (see event.make_run_to_
+    # coverage_fn).
+    check_in_flight = not cfg.overlay_heal_resolved
 
     def cond_live(s, target_count, until):
         # The in-flight term (psum of each shard's ring-occupied
@@ -554,9 +717,17 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
         # (event.make_run_to_coverage_fn).  Indicator, not count:
         # a cross-shard sum of entry counts could wrap int32 near
         # ring occupancy.
-        return ((s.total_received < target_count)
-                & (s.tick < max_steps) & (s.tick < until)
-                & (jax.lax.psum(event.in_flight(s), AXIS) > 0))
+        live = ((s.total_received < target_count)
+                & (s.tick < max_steps) & (s.tick < until))
+        if check_in_flight:
+            live = live & (jax.lax.psum(event.in_flight(s), AXIS) > 0)
+        return live
+
+    def advance(s, base_key):
+        s = jax.lax.fori_loop(0, steps, lambda _, x: step(x, base_key), s)
+        if heal is not None:
+            s = heal(s, base_key)
+        return s
 
     if telemetry:
         from gossip_simulator_tpu.utils import telemetry as telem
@@ -573,8 +744,7 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
 
                 def body(carry):
                     s, h = carry
-                    s = jax.lax.fori_loop(
-                        0, steps, lambda _, x: step(x, base_key), s)
+                    s = advance(s, base_key)
                     row = telem.gossip_probe(
                         s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
                         pmax=lambda x: jax.lax.pmax(x, AXIS))
@@ -594,12 +764,9 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
     def run(st: EventState, base_key: jax.Array, target_count: jax.Array,
             until: jax.Array) -> EventState:
         def run_shard(st, base_key, target_count, until):
-            def body(s):
-                return jax.lax.fori_loop(
-                    0, steps, lambda _, x: step(x, base_key), s)
-
             return jax.lax.while_loop(
-                lambda s: cond_live(s, target_count, until), body, st)
+                lambda s: cond_live(s, target_count, until),
+                lambda s: advance(s, base_key), st)
 
         return _shard_map(mesh, run_shard, in_specs=(specs, P(), P(), P()),
                           out_specs=specs)(st, base_key, target_count, until)

@@ -35,13 +35,19 @@ from gossip_simulator_tpu.utils import rng as _rng
 I32 = jnp.int32
 
 
-def sim_state_specs() -> SimState:
+def sim_state_specs(cfg: Config) -> SimState:
+    # down_since is node-sharded only when the fault machinery allocates
+    # the full axis (Config.faults_enabled); the fault-free 1-element
+    # placeholder is replicated.
     return SimState(
         received=P(AXIS), crashed=P(AXIS), removed=P(AXIS),
         friends=P(AXIS, None), friend_cnt=P(AXIS),
         pending=P(None, AXIS), rebroadcast=P(None, AXIS),
         tick=P(), total_message=P(), total_received=P(), total_crashed=P(),
         exchange_overflow=P(),
+        down_since=P(AXIS) if cfg.faults_enabled else P(),
+        scen_crashed=P(), scen_recovered=P(), part_dropped=P(),
+        heal_repaired=P(),
     )
 
 
@@ -92,11 +98,21 @@ def make_sharded_tick(cfg: Config, mesh):
     s = mesh.shape[AXIS]
     n_local = shard_size(cfg.n, mesh)
 
+    track_part = cfg.scenario_resolved.has_partitions
+
     def tick_shard(st: SimState, base_key: jax.Array) -> SimState:
         shard = jax.lax.axis_index(AXIS)
+        gid0 = shard * n_local
+        # Scenario faults draw on (tick, GLOBAL-id) keys -- shard-count
+        # invariant, unlike the shard-folded step keys below -- so a
+        # scenario trajectory's crash/recovery schedule is identical on
+        # any mesh (and across a reshard-resume).
+        st, dsc, dsr = epidemic.apply_fault_window(
+            cfg, st, gid0 + jnp.arange(n_local, dtype=I32), base_key)
         keys = epidemic.tick_keys(base_key, st.tick, shard)
         stp, senders, dslot, (dm, dr, dc) = epidemic.tick_core(cfg, st, keys)
         width = stp.friends.shape[1]
+        zblk = jnp.zeros((), I32)
         if cfg.compact_resolved:
             # Compacted wave: only sender rows reach the RNG/sort/all_to_all.
             # Chunk count is agreed across shards (pmax) so every shard
@@ -117,22 +133,41 @@ def make_sharded_tick(cfg: Config, mesh):
                 rcap = min(exchange.epidemic_cap(n_local, width, s),
                            ccap * width)
 
-            def body(_, carry):
-                pending, remaining, ovf = carry
-                dstg, slots, valid, remaining = epidemic.compact_gather(
-                    cfg, stp.friends, stp.friend_cnt, dslot, keys["delay"],
-                    keys["drop"], st.tick, remaining, ccap)
-                pending, o = _deposit_routed(cfg, n_local, s, pending,
-                                             dstg, slots, valid, rcap)
-                return pending, remaining, ovf + o
+            if track_part:
+                def body_p(_, carry):
+                    pending, remaining, ovf, blk = carry
+                    (dstg, slots, valid, remaining,
+                     b2) = epidemic.compact_gather(
+                        cfg, stp.friends, stp.friend_cnt, dslot,
+                        keys["delay"], keys["drop"], st.tick, remaining,
+                        ccap, gid0=gid0)
+                    pending, o = _deposit_routed(cfg, n_local, s, pending,
+                                                 dstg, slots, valid, rcap)
+                    return pending, remaining, ovf + o, blk + b2
 
-            pending, _, ovf = jax.lax.fori_loop(
-                0, chunks, body,
-                (stp.pending, senders, jnp.zeros((), I32)))
+                pending, _, ovf, blk = jax.lax.fori_loop(
+                    0, chunks, body_p,
+                    (stp.pending, senders, jnp.zeros((), I32), zblk))
+            else:
+                def body(_, carry):
+                    pending, remaining, ovf = carry
+                    (dstg, slots, valid, remaining,
+                     _b) = epidemic.compact_gather(
+                        cfg, stp.friends, stp.friend_cnt, dslot,
+                        keys["delay"], keys["drop"], st.tick, remaining,
+                        ccap)
+                    pending, o = _deposit_routed(cfg, n_local, s, pending,
+                                                 dstg, slots, valid, rcap)
+                    return pending, remaining, ovf + o
+
+                pending, _, ovf = jax.lax.fori_loop(
+                    0, chunks, body,
+                    (stp.pending, senders, jnp.zeros((), I32)))
+                blk = zblk
         else:
-            dst, slots, valid = epidemic.edges_from_senders(
+            dst, slots, valid, blk = epidemic.edges_from_senders(
                 cfg, stp.friends, stp.friend_cnt, senders, dslot,
-                keys["drop"])
+                keys["drop"], tick=st.tick, gid0=gid0)
             pending, ovf = _deposit_routed(
                 cfg, n_local, s, stp.pending, dst, slots, valid,
                 exchange.epidemic_cap(n_local, width, s))
@@ -141,12 +176,21 @@ def make_sharded_tick(cfg: Config, mesh):
         # in epidemic.make_tick_fn (axon platform, cond + dynamic fori).
         # The psum'd per-tick delta stays int32 (bounded by the delay-ring
         # capacity); the carry into the 64-bit pair is replicated per shard.
-        return stp._replace(
+        stp = stp._replace(
             pending=pending,
             total_message=msg64_add(stp.total_message, dm),
             total_received=stp.total_received + dr,
             total_crashed=stp.total_crashed + dc,
             exchange_overflow=stp.exchange_overflow + ovf)
+        if cfg.scenario_resolved.active:
+            dsc, dsr, blk = jax.lax.psum(
+                (jnp.asarray(dsc, I32), jnp.asarray(dsr, I32),
+                 jnp.asarray(blk, I32)), AXIS)
+            stp = stp._replace(
+                scen_crashed=stp.scen_crashed + dsc,
+                scen_recovered=stp.scen_recovered + dsr,
+                part_dropped=stp.part_dropped + blk)
+        return stp
 
     return tick_shard
 
@@ -247,6 +291,66 @@ def make_sharded_step(cfg: Config, mesh):
     return make_sharded_tick(cfg, mesh)
 
 
+def make_sharded_heal(cfg: Config, mesh):
+    """Sharded ring-engine overlay healing (shard_map body; None when
+    -overlay-heal is off).  The failure detector's verdicts are per-shard
+    (crash clock and crashed bits live with the rows); ONE bool-per-node
+    all_gather publishes them so every shard can condemn its remote
+    friends, then the repaired-edge re-sends ride the normal all_to_all
+    route.  Heal draws are (tick, GLOBAL-id)-keyed (scenario.heal_and_
+    wave), so the repair schedule matches the single-device engine
+    bit-for-bit."""
+    if not cfg.overlay_heal_resolved:
+        return None
+    from gossip_simulator_tpu import scenario as _scen
+
+    s = mesh.shape[AXIS]
+    n_local = shard_size(cfg.n, mesh)
+    detect = cfg.heal_detect_ms
+    d = epidemic.ring_depth(cfg)
+
+    def heal_shard(st: SimState, base_key: jax.Array) -> SimState:
+        shard = jax.lax.axis_index(AXIS)
+        gids = shard * n_local + jnp.arange(n_local, dtype=I32)
+        rows = jnp.arange(n_local, dtype=I32)
+        k = st.friends.shape[1]
+        detected = _scen.detect_dead(st.crashed, st.down_since, st.tick,
+                                     detect)
+        healer_ok = ~st.crashed
+        sender_inf = st.received & ~st.crashed & ~st.removed
+        bits_global = jax.lax.all_gather(
+            _scen.heal_peer_bits(detected, sender_inf), AXIS, tiled=True)
+        friends, resend, pull, delay, clear, rep, blk = _scen.heal_and_wave(
+            cfg, st.friends, st.friend_cnt, bits_global, healer_ok,
+            sender_inf, _scen.rejoined_mask(st.down_since), gids, st.tick,
+            base_key)
+        if cfg.effective_time_mode == "rounds":
+            dslot = jnp.broadcast_to((st.tick + 1) % d,
+                                     (n_local,)).astype(I32)
+        else:
+            dslot = ((st.tick + delay) % d).astype(I32)
+        dst = jnp.where(resend, friends, -1).reshape(-1)
+        slots = jnp.broadcast_to(dslot[:, None], (n_local, k)).reshape(-1)
+        pending, ovf = _deposit_routed(
+            cfg, n_local, s, st.pending, dst, slots, resend.reshape(-1),
+            exchange.epidemic_cap(n_local, k, s))
+        # Rejoin pull responses deliver to the puller's OWN row -- always
+        # shard-local, so they skip the route.
+        pdst = jnp.broadcast_to(rows[:, None], (n_local, k)).reshape(-1)
+        pending = epidemic.deposit_local(pending, pdst, slots,
+                                         pull.reshape(-1))
+        rep, blk, ovf = jax.lax.psum(
+            (rep, jnp.asarray(blk, I32), ovf), AXIS)
+        return st._replace(
+            friends=friends, pending=pending,
+            down_since=jnp.where(clear, -1, st.down_since),
+            heal_repaired=st.heal_repaired + rep,
+            part_dropped=st.part_dropped + blk,
+            exchange_overflow=st.exchange_overflow + ovf)
+
+    return heal_shard
+
+
 def make_sharded_seed(cfg: Config, mesh):
     """Uniform-random global sender; its broadcast is routed like any wave."""
     s = mesh.shape[AXIS]
@@ -270,8 +374,12 @@ def make_sharded_seed(cfg: Config, mesh):
                                total_received=total_received)
         dslot = epidemic.row_slot(cfg, kd, st.tick,
                                   jnp.arange(n_local, dtype=I32))
-        dst, slots, valid = epidemic.edges_from_senders(
-            cfg, st.friends, st.friend_cnt, is_sender, dslot, kp)
+        dst, slots, valid, blk = epidemic.edges_from_senders(
+            cfg, st.friends, st.friend_cnt, is_sender, dslot, kp,
+            tick=st.tick, gid0=shard * n_local)
+        if cfg.scenario_resolved.has_partitions:
+            st = st._replace(part_dropped=st.part_dropped
+                             + jax.lax.psum(blk, AXIS))
         pending, ovf = _deposit_routed(
             cfg, n_local, s, st.pending, dst, slots, valid,
             exchange.epidemic_cap(n_local, st.friends.shape[1], s))
@@ -303,7 +411,7 @@ def make_sharded_init(cfg: Config, mesh):
                                        rows=n_local)
         return epidemic.init_state(cfg, friends, cnt, n_local=n_local)
 
-    specs = sim_state_specs()
+    specs = sim_state_specs(cfg)
     fn = _shard_map(mesh, init_shard, in_specs=(), out_specs=specs)
     return jax.jit(fn)
 
@@ -370,17 +478,21 @@ def make_sharded_overlay_init(cfg: Config, mesh):
 
 def make_window_fn(cfg: Config, mesh, window: int):
     step = make_sharded_step(cfg, mesh)
-    specs = sim_state_specs()
+    heal = make_sharded_heal(cfg, mesh)
+    specs = sim_state_specs(cfg)
 
     def window_shard(st: SimState, base_key: jax.Array) -> SimState:
-        return jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), st)
+        st = jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), st)
+        if heal is not None:
+            st = heal(st, base_key)
+        return st
 
     return jax.jit(_shard_map(mesh, window_shard, in_specs=(specs, P()),
                               out_specs=specs), donate_argnums=(0,))
 
 
 def make_seed_fn(cfg: Config, mesh):
-    specs = sim_state_specs()
+    specs = sim_state_specs(cfg)
     return jax.jit(_shard_map(mesh, make_sharded_seed(cfg, mesh),
                               in_specs=(specs, P()), out_specs=specs))
 
@@ -399,10 +511,14 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
     psum-replicated by the step; the per-shard occupancy/removed probes
     reduce across shards so every shard writes identical rows."""
     step = make_sharded_step(cfg, mesh)
-    specs = sim_state_specs()
+    heal = make_sharded_heal(cfg, mesh)
+    specs = sim_state_specs(cfg)
     window = 1 if cfg.effective_time_mode == "rounds" else 10
     max_steps = cfg.max_rounds
-    check_in_flight = cfg.protocol != "pushpull"
+    # Heal-on runs drop the early-death exit: healing can revive an empty
+    # ring (see epidemic.make_run_to_coverage_fn).
+    check_in_flight = (cfg.protocol != "pushpull"
+                       and not cfg.overlay_heal_resolved)
 
     def cond_live(s, target_count, until):
         live = ((s.total_received < target_count)
@@ -416,6 +532,12 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
             live = live & (jax.lax.psum(state_mod.in_flight(s),
                                         AXIS) > 0)
         return live
+
+    def advance(s, base_key):
+        s = jax.lax.fori_loop(0, window, lambda _, x: step(x, base_key), s)
+        if heal is not None:
+            s = heal(s, base_key)
+        return s
 
     if telemetry:
         from gossip_simulator_tpu.utils import telemetry as telem
@@ -432,8 +554,7 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
 
                 def body(carry):
                     s, h = carry
-                    s = jax.lax.fori_loop(
-                        0, window, lambda _, x: step(x, base_key), s)
+                    s = advance(s, base_key)
                     row = telem.gossip_probe(
                         s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
                         pmax=lambda x: jax.lax.pmax(x, AXIS))
@@ -453,12 +574,9 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
     def run(st: SimState, base_key: jax.Array, target_count: jax.Array,
             until: jax.Array) -> SimState:
         def run_shard(st, base_key, target_count, until):
-            def body(s):
-                return jax.lax.fori_loop(
-                    0, window, lambda _, x: step(x, base_key), s)
-
             return jax.lax.while_loop(
-                lambda s: cond_live(s, target_count, until), body, st)
+                lambda s: cond_live(s, target_count, until),
+                lambda s: advance(s, base_key), st)
 
         return _shard_map(mesh, run_shard, in_specs=(specs, P(), P(), P()),
                           out_specs=specs)(st, base_key, target_count, until)

@@ -1,0 +1,461 @@
+"""Fault-injection scenarios: dynamic churn, crash waves, recovery and
+partition masks over *simulated* time.
+
+The reference's fault model is static -- a fixed per-send drop rate and a
+per-reception crash that black-holes a node forever (simulator.go:144,
+179-184).  A scenario adds the dynamic dimension: a small timeline of
+events, scheduled on the simulated clock, that every engine applies inside
+its jitted step functions.
+
+Config surface: ``-scenario off`` (default -- the traced programs are
+untouched, bit-identical to a scenario-less build), a path to a JSON
+timeline file, or the JSON itself inline.  Schema::
+
+    {
+      "groups":   4,        # node groups: contiguous global-id ranges
+      "downtime": 500,      # ms until a crashed node reboots (0=permanent)
+      "events": [
+        {"type": "crash",     "at": 100, "frac": 0.05, "group": 2},
+        {"type": "churn",     "start": 0, "end": 2000, "rate": 0.2},
+        {"type": "partition", "start": 500, "end": 900, "group": 0}
+      ]
+    }
+
+* ``crash``: one-shot wave at tick ``at`` -- each live node (in ``group``,
+  or everywhere with group omitted/-1) crashes with probability ``frac``.
+  Group-targeted waves are the *correlated per-shard failure* primitive:
+  groups are contiguous id ranges, exactly the sharded backend's slices
+  when ``groups`` equals the device count.
+* ``churn``: steady churn over [start, end): each live node crashes with
+  probability ``rate`` per 1000 simulated ms (so ``rate`` ~ the expected
+  churned fraction per simulated second).
+* ``partition``: traffic black-hole over [start, end): a message whose
+  SEND tick falls in the window and whose (src, dst) groups are split is
+  dropped (counted in ``Stats.partition_dropped``, never silent).  With
+  ``group`` set, that group is isolated from the rest; with -1/omitted,
+  ALL cross-group traffic is blocked (a full G-way split).
+
+Recovery (``downtime`` > 0) revives EVERY crash -- scenario crashes and
+per-reception crashes alike -- ``downtime`` ms after it happened: the
+"machines reboot" model.  A recovered node rejoins live and susceptible
+(its received bit, if it had one, is kept: counters stay monotone); it
+receives again, but nobody re-sends to it unless ``-overlay-heal on``
+repairs edges toward it (models/overlay.heal_dead_friends).  This is a
+documented divergence from the reference's permanent black-hole.
+
+Determinism: every scenario draw is keyed on (seed, window/tick,
+OP_SCENARIO, event-index, GLOBAL node id) -- independent of the shard
+count and of the shard-folded step keys -- so a scenario trajectory is
+identical between the single-device and S-shard event engines, and a
+checkpoint written at S=1 resumes bit-identically at S=8 (tested).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashEvent:
+    at: int  # tick the wave fires
+    frac: float  # per-node crash probability
+    group: int = -1  # restrict to one group (-1 = all nodes)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnEvent:
+    start: int  # [start, end) active window, ticks
+    end: int
+    rate: float  # expected churned fraction per 1000 simulated ms
+    group: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionEvent:
+    start: int  # [start, end) send-tick window, ticks
+    end: int
+    group: int = -1  # isolate this group (-1 = block ALL cross-group)
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """Parsed, validated fault timeline.  All fields are Python constants:
+    the jitted steps close over them, so ``-scenario off`` (the empty
+    Scenario) traces exactly the pre-scenario programs."""
+
+    crashes: tuple[CrashEvent, ...] = ()
+    churns: tuple[ChurnEvent, ...] = ()
+    partitions: tuple[PartitionEvent, ...] = ()
+    groups: int = 1
+    downtime: int = 0  # ticks until reboot; 0 = crashes stay permanent
+
+    @property
+    def has_faults(self) -> bool:
+        """Any crash/churn/recovery machinery in the step (the gate for
+        the scenario tick and the down_since array)."""
+        return bool(self.crashes or self.churns) or self.downtime > 0
+
+    @property
+    def has_partitions(self) -> bool:
+        return bool(self.partitions)
+
+    @property
+    def active(self) -> bool:
+        return self.has_faults or self.has_partitions
+
+    def validate(self) -> "Scenario":
+        if self.groups < 1:
+            raise ValueError(f"scenario groups must be >= 1, got "
+                             f"{self.groups}")
+        if self.downtime < 0:
+            raise ValueError(f"scenario downtime must be >= 0, got "
+                             f"{self.downtime}")
+        for e in self.crashes:
+            if e.at < 0:
+                raise ValueError(f"crash event at={e.at} must be >= 0")
+            if not 0.0 <= e.frac <= 1.0:
+                raise ValueError(f"crash frac must be in [0,1], got "
+                                 f"{e.frac}")
+        for e in self.churns:
+            if e.end <= e.start or e.start < 0:
+                raise ValueError(
+                    f"churn window [{e.start},{e.end}) must be nonempty "
+                    "and nonnegative")
+            if not 0.0 <= e.rate <= 1000.0:
+                raise ValueError(f"churn rate must be in [0,1000], got "
+                                 f"{e.rate}")
+        for e in self.partitions:
+            if e.end <= e.start or e.start < 0:
+                raise ValueError(
+                    f"partition window [{e.start},{e.end}) must be "
+                    "nonempty and nonnegative")
+        for e in (*self.crashes, *self.churns, *self.partitions):
+            if e.group != -1 and not 0 <= e.group < self.groups:
+                raise ValueError(
+                    f"event group {e.group} outside [0, {self.groups})")
+        if self.partitions and self.groups < 2:
+            raise ValueError(
+                "partition events need scenario groups >= 2 (a 1-group "
+                "world has no cross-group traffic to block)")
+        return self
+
+
+OFF = Scenario()
+
+
+@functools.lru_cache(maxsize=32)
+def parse(spec: str) -> Scenario:
+    """``off``/empty -> the inert Scenario; otherwise inline JSON (starts
+    with ``{``) or a path to a JSON timeline file.  Raises ValueError with
+    a flag-specific message on anything malformed."""
+    if not spec or spec == "off":
+        return OFF
+    if spec.lstrip().startswith("{"):
+        try:
+            raw = json.loads(spec)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"-scenario inline JSON is invalid: {e}")
+    else:
+        if not os.path.exists(spec):
+            raise ValueError(
+                f"-scenario {spec!r} is neither 'off', inline JSON, nor "
+                "an existing timeline file")
+        with open(spec) as f:
+            try:
+                raw = json.load(f)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"-scenario file {spec} is invalid "
+                                 f"JSON: {e}")
+    if not isinstance(raw, dict):
+        raise ValueError("-scenario JSON must be an object "
+                         "{groups, downtime, events}")
+    known = {"groups", "downtime", "events"}
+    extra = set(raw) - known
+    if extra:
+        raise ValueError(f"-scenario: unknown keys {sorted(extra)}")
+    crashes, churns, partitions = [], [], []
+    for i, ev in enumerate(raw.get("events", [])):
+        if not isinstance(ev, dict) or "type" not in ev:
+            raise ValueError(f"-scenario events[{i}] needs a 'type'")
+        t = ev["type"]
+        try:
+            if t == "crash":
+                crashes.append(CrashEvent(
+                    at=int(ev["at"]), frac=float(ev["frac"]),
+                    group=int(ev.get("group", -1))))
+            elif t == "churn":
+                churns.append(ChurnEvent(
+                    start=int(ev["start"]), end=int(ev["end"]),
+                    rate=float(ev["rate"]),
+                    group=int(ev.get("group", -1))))
+            elif t == "partition":
+                partitions.append(PartitionEvent(
+                    start=int(ev["start"]), end=int(ev["end"]),
+                    group=int(ev.get("group", -1))))
+            else:
+                raise ValueError(
+                    f"-scenario events[{i}]: unknown type {t!r} "
+                    "(crash|churn|partition)")
+        except KeyError as e:
+            raise ValueError(f"-scenario events[{i}] ({t}) is missing "
+                             f"field {e}")
+    return Scenario(
+        crashes=tuple(crashes), churns=tuple(churns),
+        partitions=tuple(partitions),
+        groups=int(raw.get("groups", 1)),
+        downtime=int(raw.get("downtime", 0))).validate()
+
+
+# --------------------------------------------------------------------------
+# Traced helpers.  All take the Scenario as a Python constant and global
+# node ids / ticks as (possibly traced) arrays.
+# --------------------------------------------------------------------------
+
+# RNG op tag for every scenario draw (crash waves, churn) and the healing
+# machinery (replacement draws, repaired-edge re-sends).  Kept here, not in
+# utils/rng.py, so the rng module stays a closed reference of the
+# pre-scenario streams.
+OP_SCENARIO = 12
+OP_HEAL = 13
+OP_HEAL_SEND = 14
+
+
+def group_size(scen: Scenario, n: int) -> int:
+    """Nodes per group: contiguous global-id ranges (ceil so the last
+    group absorbs the remainder)."""
+    return -(-n // scen.groups)
+
+
+def group_of(scen: Scenario, n: int, ids):
+    return ids // group_size(scen, n)
+
+
+def _event_keys(base_key, window_idx, idx: int):
+    """Key for scenario event `idx` in window `window_idx`: shard-count
+    independent (no shard fold), row keys derived per GLOBAL id by the
+    caller."""
+    return jax.random.fold_in(
+        _rng.tick_key(base_key, window_idx, OP_SCENARIO), idx)
+
+
+def fault_window(scen: Scenario, n: int, tick0, nticks: int, ids_global,
+                 crashed, down_since, base_key):
+    """Apply the scenario's crash/churn/recovery timeline over the window
+    [tick0, tick0 + nticks).
+
+    `crashed` is the caller's bool[n_local] view (the event engine adapts
+    its flags bit); `down_since` is int32[n_local] crash ticks (-1 = not
+    crashed / crash time unknown).  Returns
+    ``(new_crash, recover, down_since', d_crashed, d_recovered)`` --
+    boolean masks plus LOCAL count deltas (sharded callers psum them).
+
+    Order within the window: recovery first (a node whose downtime ends
+    this window is live again and exposed to this window's churn draw),
+    then the crash draws on live nodes.  Draws are keyed on
+    (window-index, event-index, GLOBAL id): shard-count invariant, so
+    S=1 and S=8 runs -- and a reshard-resumed checkpoint -- crash the
+    same nodes at the same ticks.  The window index is tick0 // nticks
+    (every engine advances in fixed nticks strides from 0)."""
+    widx = tick0 // nticks
+    if scen.downtime > 0:
+        recover = crashed & (down_since >= 0) \
+            & (tick0 >= down_since + scen.downtime)
+        crashed = crashed & ~recover
+        # Rejoin marker -(t+2): negative (so the node reads as live to
+        # recovery and detection alike) but distinguishable from the
+        # never-crashed -1 -- the healing pass's rejoin anti-entropy
+        # consumes it (heal_and_wave), letting a freshly rebooted node
+        # pull the rumor from its live infected friends.  Inert when
+        # healing is off.
+        down_since = jnp.where(recover, -(tick0.astype(I32) + 2),
+                               down_since)
+    else:
+        recover = jnp.zeros(crashed.shape, bool)
+    hit = jnp.zeros(crashed.shape, bool)
+    gid = group_of(scen, n, ids_global) if scen.groups > 1 else None
+    t1 = tick0 + nticks
+    for i, e in enumerate(scen.crashes):
+        fires = (e.at >= tick0) & (e.at < t1)
+        u = _row_uniform(_event_keys(base_key, widx, i), ids_global)
+        m = (u < e.frac) & fires
+        if e.group >= 0:
+            m = m & (gid == e.group)
+        hit = hit | m
+    base = len(scen.crashes)
+    for i, e in enumerate(scen.churns):
+        # Expected per-tick hazard rate/1000; the window draw uses the
+        # overlap-scaled probability (exact for the window-quantized
+        # process both engines step at).
+        lo = jnp.maximum(tick0, e.start)
+        hi = jnp.minimum(t1, e.end)
+        overlap = jnp.maximum(hi - lo, 0).astype(jnp.float32)
+        p = overlap * (e.rate / 1000.0)
+        u = _row_uniform(_event_keys(base_key, widx, base + i), ids_global)
+        m = u < p
+        if e.group >= 0:
+            m = m & (gid == e.group)
+        hit = hit | m
+    new_crash = hit & ~crashed
+    down_since = jnp.where(new_crash, tick0.astype(I32), down_since)
+    return (new_crash, recover, down_since,
+            new_crash.sum(dtype=I32), recover.sum(dtype=I32))
+
+
+def _row_uniform(key, rows):
+    """One uniform[0,1) per GLOBAL row id (row-keyed like rng.row_keys, so
+    a shard's slice draws exactly the values the full axis would)."""
+    ks = _rng.row_keys(key, rows)
+    return jax.vmap(lambda kk: jax.random.uniform(kk, ()))(ks)
+
+
+def partition_blocked(scen: Scenario, n: int, send_tick, src_gids,
+                      dst_gids):
+    """bool mask, True where a send from src to dst at `send_tick` crosses
+    an active partition.  `send_tick` broadcasts against the id arrays
+    (a scalar for the ring engine's per-tick wave, per-sender ticks for
+    the event engine's batched appends).  Semantics: the partition applies
+    at SEND time -- a message emitted inside the window is black-holed
+    even if its delivery tick falls after the partition heals (the wire
+    was down when it left).  dst < 0 lanes (padding) come back False."""
+    if not scen.partitions:
+        return jnp.zeros(jnp.broadcast_shapes(
+            jnp.shape(src_gids), jnp.shape(dst_gids)), bool)
+    gs = group_of(scen, n, src_gids)
+    gd = group_of(scen, n, jnp.maximum(dst_gids, 0))
+    blocked = jnp.zeros(jnp.broadcast_shapes(gs.shape, gd.shape), bool)
+    for e in scen.partitions:
+        live = (send_tick >= e.start) & (send_tick < e.end)
+        if e.group >= 0:
+            cross = (gs == e.group) != (gd == e.group)
+        else:
+            cross = gs != gd
+        blocked = blocked | (live & cross)
+    return blocked & (dst_gids >= 0)
+
+
+# Packed per-node bits the healing pass publishes across shards in ONE
+# uint8 all_gather: the detector's verdict and "carries the rumor and can
+# answer a rejoin pull".
+HEAL_DEAD = 1  # detect_dead verdict
+HEAL_INFECTIVE = 2  # infected & live (& not SIR-removed)
+
+
+def heal_peer_bits(detected, infective):
+    import jax.numpy as jnp  # noqa: F811
+
+    return detected.astype(jnp.uint8) * jnp.uint8(HEAL_DEAD) \
+        + infective.astype(jnp.uint8) * jnp.uint8(HEAL_INFECTIVE)
+
+
+def heal_and_wave(cfg, friends, friend_cnt, peer_bits_global, healer_ok,
+                  sender_inf, rejoined, ids_global, tick, base_key):
+    """One healing pass (every poll window when ``-overlay-heal on``),
+    three pieces:
+
+    1. REPAIR -- replace detector-condemned friends via the phase-1
+       makeup draw (overlay.heal_dead_friends).
+    2. RE-SEND -- an INFECTED healer re-broadcasts the rumor over each
+       repaired edge (without this, topology repair alone cannot carry
+       the rumor across edges that were rewired after the healer's
+       one-shot broadcast already happened).
+    3. REJOIN PULL -- a node whose reboot marker is set (fault_window's
+       -(t+2) encoding in down_since) asks its friends for the rumor;
+       each live INFECTED friend's response is a normal delayed delivery
+       back to the rejoined node (counted at delivery like any message).
+       This is the rejoin anti-entropy: a node that was down while its
+       neighbors broadcast has no other path back to coverage.
+
+    Re-sends and pull responses are real network traffic: per-link drop
+    draws, a per-node shared delay (the reference's one-delay-per-
+    broadcast, simulator.go:141-142), and the partition mask.  All draws
+    are (tick, GLOBAL-id)-keyed (OP_HEAL / OP_HEAL_SEND): shard-count
+    invariant, reshard-resume safe.
+
+    `peer_bits_global` is the full-axis uint8 heal_peer_bits vector (the
+    sharded engines all_gather it -- one byte per node).  Returns
+    ``(friends', resend[n, k], pull[n, k], delay[n], down_clear[n],
+    repaired_local, partition_blocked_local)``; `pull` marks friend lanes
+    whose response delivers to the LANE'S OWN ROW (always shard-local),
+    `down_clear` is the consumed-rejoin-marker mask.  The engine glue
+    owns delivery (delay ring deposit / mail-ring append / all_to_all
+    route) and the psums."""
+    from gossip_simulator_tpu.models import overlay as _ov
+
+    n = cfg.n
+    k = friends.shape[1]
+    detected_global = (peer_bits_global & HEAL_DEAD) > 0
+    hk = _rng.tick_key(base_key, tick, OP_HEAL)
+    friends, dead, repaired = _ov.heal_dead_friends(
+        n, friends, friend_cnt, detected_global, healer_ok, ids_global, hk)
+    kd = _rng.tick_key(base_key, tick, OP_HEAL_SEND)
+    kp = jax.random.fold_in(kd, 1)
+    kq = jax.random.fold_in(kd, 2)
+    if cfg.effective_time_mode == "ticks":
+        delay = _rng.row_uniform_delay(kd, cfg.delaylow, cfg.delayhigh,
+                                       ids_global)
+    else:
+        delay = jnp.ones(ids_global.shape, I32)
+    drop_p = int(cfg.droprate * 100) / 100.0 if cfg.compat_reference \
+        else cfg.droprate
+    drop = _rng.row_bernoulli(kp, drop_p, ids_global, k)
+    resend = dead & sender_inf[:, None] & ~drop
+    # Rejoin pull: the rebooted node contacts every current friend; an
+    # infective one answers with the rumor (response lane -> own row).
+    # Only rumor-bearing responses are materialized (an uninfected
+    # friend's reply carries nothing to deliver or count).
+    in_range = jnp.arange(k, dtype=I32)[None, :] < friend_cnt[:, None]
+    fbits = peer_bits_global.at[jnp.maximum(friends, 0)].get()
+    qdrop = _rng.row_bernoulli(kq, drop_p, ids_global, k)
+    pull = rejoined[:, None] & healer_ok[:, None] & in_range \
+        & (friends >= 0) & ((fbits & HEAL_INFECTIVE) > 0) & ~qdrop
+    scen = cfg.scenario_resolved
+    blocked_n = jnp.zeros((), I32)
+    if scen.has_partitions:
+        blocked = partition_blocked(
+            scen, n, tick, ids_global[:, None], friends) & resend
+        # The pull response travels friend -> rejoined node: same pair,
+        # opposite direction -- the partition masks are symmetric (group
+        # predicates), so one blocked() evaluation covers both.
+        qblocked = partition_blocked(
+            scen, n, tick, ids_global[:, None], friends) & pull
+        blocked_n = blocked.sum(dtype=I32) + qblocked.sum(dtype=I32)
+        resend = resend & ~blocked
+        pull = pull & ~qblocked
+    return (friends, resend, pull, delay, rejoined, repaired, blocked_n)
+
+
+def rejoined_mask(down_since):
+    """Nodes carrying fault_window's reboot marker (consumed by the next
+    healing pass's rejoin pull)."""
+    return down_since <= -2
+
+
+def detect_dead(crashed, down_since, tick, detect_ms: int):
+    """The failure detector's verdict on the LOCAL rows: a node is
+    condemned once it has been crashed for >= detect_ms -- the windowed
+    failed-delivery model (every send to it since the crash black-holed;
+    after detect_ms of that, its senders give up on it).  No actor-style
+    heartbeats: the crash clock (down_since) IS the accountant."""
+    return crashed & (down_since >= 0) & (tick - down_since >= detect_ms)
+
+
+def down_shape(enabled: bool, n_local: int) -> int:
+    """down_since rows: the full local axis when the fault machinery is on
+    (scenario faults or healing), a 1-element placeholder otherwise --
+    the placeholder keeps the state pytree's structure stable across
+    configs without costing n * 4 bytes on every fault-free run."""
+    return n_local if enabled else 1
+
+
+def init_down_since(enabled: bool, n_local: int) -> jnp.ndarray:
+    return jnp.full((down_shape(enabled, n_local),), -1, I32)

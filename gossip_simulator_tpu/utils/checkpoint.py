@@ -8,6 +8,7 @@ JSON sidecar of counters.  Orbax would also work, but npz keeps the native
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any, Optional
@@ -17,20 +18,50 @@ import numpy as np
 from gossip_simulator_tpu.utils.metrics import Stats
 
 
+def _digest(path: str) -> str:
+    """sha256 of the snapshot file's bytes (streamed; snapshots are GBs
+    at flagship scale)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def save(ckpt_dir: str, window: int, tree: dict[str, Any], stats: Stats,
          prefix: str = "state", extra_meta: Optional[dict] = None) -> str:
     """`prefix` namespaces the two phases: phase-2 snapshots are
     ``state_*``, phase-1 overlay snapshots ``overlay_*``.  ``latest()``
     sorts lexicographically, and "overlay" < "state", so any phase-2
     snapshot outranks every phase-1 one -- resuming always continues from
-    the furthest phase."""
+    the furthest phase.
+
+    Atomic: both files are written to ``.tmp`` names and os.replace'd
+    into place -- a crash mid-save leaves either the previous snapshot or
+    none, never a torn one (``latest()`` ignores the tmp names).  The
+    sidecar carries a sha256 content digest; ``load()`` verifies it, so a
+    snapshot corrupted AFTER a clean save (truncation, bit rot, a partial
+    copy between filesystems) is rejected with a clear error instead of
+    restoring garbage."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"{prefix}_{window:08d}.npz")
     arrays = {k: np.asarray(v) for k, v in tree.items()}
-    np.savez_compressed(path, **arrays)
-    with open(path + ".json", "w") as f:
-        json.dump({"window": window, **(extra_meta or {}),
-                   **stats.to_dict()}, f)
+    tmp = path + ".tmp"
+    # np.savez appends ".npz" to names without it -- write under the real
+    # suffix structure by handing it a file object.
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    meta = {"window": window, **(extra_meta or {}), **stats.to_dict(),
+            "sha256": _digest(tmp)}
+    with open(path + ".json.tmp", "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # Sidecar lands first: a crash between the two replaces leaves a
+    # (new json, old/no npz) pair, which load() rejects via the digest --
+    # never silently restores a mismatched pair.
+    os.replace(path + ".json.tmp", path + ".json")
+    os.replace(tmp, path)
     return path
 
 
@@ -42,11 +73,29 @@ def latest(ckpt_dir: str) -> Optional[str]:
 
 
 def load(path: str) -> tuple[dict[str, np.ndarray], dict]:
-    arrays = dict(np.load(path))
+    """Load one snapshot, verifying the sidecar's sha256 content digest
+    when present (pre-digest snapshots load without the check).  A
+    truncated, torn or bit-rotted file raises ValueError naming the
+    snapshot instead of feeding garbage to the restore path."""
     meta = {}
     if os.path.exists(path + ".json"):
         with open(path + ".json") as f:
             meta = json.load(f)
+    want = meta.get("sha256")
+    if want is not None:
+        got = _digest(path)
+        if got != want:
+            raise ValueError(
+                f"checkpoint {path} is corrupt: content digest {got[:16]}… "
+                f"does not match its sidecar's {want[:16]}… (truncated or "
+                "torn write?) -- delete it and resume from an older "
+                "snapshot")
+    try:
+        arrays = dict(np.load(path))
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {path} is unreadable ({e!r}); delete it and "
+            "resume from an older snapshot") from e
     return arrays, meta
 
 
@@ -176,6 +225,29 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
         # negative (one int32 wrap reinterprets to the correct low word).
         tree["total_message"] = np.asarray(
             [0, int(tm) & 0xFFFFFFFF], dtype=np.uint32)
+    # --- fault-scenario fields (scenario.py) ------------------------------
+    from gossip_simulator_tpu import scenario as _scen
+
+    want_down = _scen.down_shape(cfg.faults_enabled, n)
+    if "down_since" not in tree:
+        # Pre-scenario snapshot: no crash clocks in flight.
+        tree["down_since"] = np.full((want_down,), -1, np.int32)
+    elif int(np.asarray(tree["down_since"]).shape[0]) != want_down:
+        if int(np.asarray(tree["down_since"]).shape[0]) == 1:
+            # Fault-free snapshot resuming INTO a scenario run: every
+            # crash so far has an unknown crash time (the placeholder
+            # held none), which -1 encodes exactly.
+            tree["down_since"] = np.full((want_down,), -1, np.int32)
+        else:
+            raise ValueError(
+                "checkpoint carries a full fault-scenario crash clock "
+                f"({int(np.asarray(tree['down_since']).shape[0])} rows) "
+                "but this run's fault machinery is off; restore with the "
+                "snapshot's -scenario/-overlay-heal flags")
+    for f in ("scen_crashed", "scen_recovered", "part_dropped",
+              "heal_repaired"):
+        if f not in tree:
+            tree[f] = np.zeros((), np.int32)
     return tree
 
 

@@ -44,6 +44,11 @@ class Stats:
     breakups: int = 0  # (BreakUps)
     mailbox_dropped: int = 0  # framework-only: capacity-overflow drops
     exchange_overflow: int = 0  # framework-only: all_to_all bucket overflow
+    # --- fault-injection scenario (scenario.py) --------------------------
+    scen_crashed: int = 0  # nodes crashed by scenario waves/churn
+    scen_recovered: int = 0  # nodes rebooted after scenario downtime
+    part_dropped: int = 0  # sends black-holed by partition masks
+    heal_repaired: int = 0  # dead friend edges replaced by -overlay-heal
     # True when the run ended with no messages in flight (the wave died) --
     # threaded here so printer.done() reports the true nonconvergence cause
     # on both the windowed and the fast path (reason parity).

@@ -32,9 +32,15 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 # Column layouts (one int32 matrix per phase keeps the per-window write a
-# single row scatter instead of one per counter).
+# single row scatter instead of one per counter).  The four scenario
+# columns (scenario.py) record the per-window fault trajectory -- crash
+# waves, reboots, repaired edges, partition-suppressed sends -- on the
+# same device-resident ride as the epidemic counters; they are constant 0
+# on scenario-less runs and the replay functions never read them, so the
+# replayed stdout/JSONL surface is unchanged.
 GOSSIP_COLS = ("tick", "received", "msg_hi", "msg_lo", "crashed", "removed",
-               "mail_high", "dropped", "overflow")
+               "mail_high", "dropped", "overflow", "scen_crashed",
+               "recovered", "repaired", "part_dropped")
 OVERLAY_COLS = ("clock", "makeups", "breakups", "dropped")
 
 
@@ -93,7 +99,9 @@ def gossip_probe(st, sir: bool, psum=None, pmax=None):
         high = pmax(high)
     msg = jax.lax.bitcast_convert_type(st.total_message, I32)
     return [st.tick, st.total_received, msg[0], msg[1], st.total_crashed,
-            removed, high, dropped, st.exchange_overflow]
+            removed, high, dropped, st.exchange_overflow,
+            st.scen_crashed, st.scen_recovered, st.heal_repaired,
+            st.part_dropped]
 
 
 def overlay_probe(st):
@@ -302,6 +310,13 @@ class TelemetryReport:
                     "dropped": cols[:count, 7].tolist(),
                     "overflow": cols[:count, 8].tolist(),
                 }
+                if cols.shape[1] > 12 and bool(cols[:count, 9:13].any()):
+                    # Scenario columns only when a scenario actually ran
+                    # (all-zero columns would bloat every record).
+                    per["scen_crashed"] = cols[:count, 9].tolist()
+                    per["scen_recovered"] = cols[:count, 10].tolist()
+                    per["heal_repaired"] = cols[:count, 11].tolist()
+                    per["part_dropped"] = cols[:count, 12].tolist()
                 out["per_window"] = per
                 out["deltas"] = {
                     "received": np.diff(cols[:count, 1],

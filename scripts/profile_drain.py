@@ -191,7 +191,7 @@ def main_looped():
 
     def sort_core(received, crashed, best, packed, evalid, entry_pos, ckey):
         flags = received.astype(jnp.uint8) + crashed.astype(jnp.uint8) * 2
-        f, dm, dr, dc, ids_s, toff_s, newly = event.drain_chunk_core(
+        f, dm, dr, dc, ids_s, toff_s, newly, _down = event.drain_chunk_core(
             crash_p, b, n, flags, packed, evalid, entry_pos, ckey)
         return (f & 1) > 0, (f & 2) > 0, best, dm + dr + dc + ids_s[0] + toff_s[0]
 

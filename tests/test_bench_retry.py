@@ -1,0 +1,63 @@
+"""bench.py pool_retry: unreachable-accelerator-pool errors retry with
+bounded backoff, then land a dated `skipped` record instead of killing
+the suite mid-record (the PR-2/PR-3 sessions' failure mode)."""
+
+import sys
+
+sys.path.insert(0, ".")  # bench.py lives at the repo root
+
+import bench  # noqa: E402
+
+
+class _Flaky:
+    def __init__(self, fail_times, exc):
+        self.calls = 0
+        self.fail_times = fail_times
+        self.exc = exc
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc
+        return {"ok": True}
+
+
+def test_retries_pool_errors_then_succeeds():
+    sleeps = []
+    fn = _Flaky(2, RuntimeError("UNAVAILABLE: failed to connect to all "
+                                "addresses (pool unreachable)"))
+    out = bench.pool_retry(fn, name="row", retries=3, base_delay_s=1.0,
+                           _sleep=sleeps.append)
+    assert out == {"ok": True}
+    assert fn.calls == 3
+    assert sleeps == [1.0, 2.0]  # exponential backoff
+
+
+def test_exhausted_retries_emit_dated_skip_record():
+    sleeps = []
+    fn = _Flaky(99, RuntimeError("DEADLINE_EXCEEDED: worker gone"))
+    out = bench.pool_retry(fn, name="row", retries=2, base_delay_s=1.0,
+                           _sleep=sleeps.append)
+    assert fn.calls == 3 and len(sleeps) == 2
+    assert out["skipped"] and out["pool_error"]
+    assert out["attempts"] == 3
+    assert "DEADLINE_EXCEEDED" in out["error"]
+    # Dated, ISO format -- the "queue the twin for the next hardware
+    # window" breadcrumb the BENCH records rely on.
+    import datetime
+
+    datetime.date.fromisoformat(out["date"])
+
+
+def test_non_pool_errors_do_not_retry():
+    sleeps = []
+    fn = _Flaky(99, ValueError("fanout must be >= 1"))
+    out = bench.pool_retry(fn, retries=3, _sleep=sleeps.append)
+    assert fn.calls == 1 and sleeps == []
+    assert out["skipped"] and not out["pool_error"]
+
+
+def test_is_pool_error_classification():
+    assert bench.is_pool_error(RuntimeError("UNAVAILABLE: socket"))
+    assert bench.is_pool_error(OSError("Connection refused"))
+    assert not bench.is_pool_error(ValueError("bad flag"))

@@ -1,0 +1,365 @@
+"""Fault-injection scenario subsystem (gossip_simulator_tpu/scenario.py).
+
+Three surfaces:
+* ``-scenario off`` A/B pins: trajectory fingerprints hard-coded from the
+  PRE-scenario build (captured at commit f3e7221 on this host/jax), so the
+  default path is pinned bit-identical to HEAD -- the PR-3-gate
+  discipline.  The CLI goldens (test_golden) pin the remaining engines'
+  full stdout byte-exact.
+* Fault semantics: crash waves (group-targeted = correlated per-shard
+  failures), steady churn, recovery after downtime, partition masks --
+  counters, group targeting, shard-count invariance of the scenario
+  draws.
+* Overlay self-healing: coverage-under-churn heal-on/off twins (the
+  graceful-degradation acceptance), repaired-edge accounting, rejoin
+  pull.
+"""
+
+import hashlib
+import json
+
+import pytest
+
+from gossip_simulator_tpu import scenario as scen_mod
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+
+def _fingerprint(cfg, max_windows=400):
+    """Per-window (round, received, message, crashed, removed) trajectory
+    hash via the windowed driver loop -- the same capture the pre-PR
+    constants below were recorded with."""
+    from gossip_simulator_tpu.backends import make_stepper
+
+    s = make_stepper(cfg)
+    s.init()
+    while not s.overlay_window()[2]:
+        pass
+    s.seed()
+    rows = []
+    for _ in range(max_windows):
+        st = s.gossip_window()
+        rows.append((st.round, st.total_received, st.total_message,
+                     st.total_crashed, st.total_removed))
+        if st.coverage >= cfg.coverage_target or s.exhausted:
+            break
+    h = hashlib.sha256(json.dumps(rows).encode()).hexdigest()[:16]
+    return {"windows": len(rows), "final": list(rows[-1]), "hash": h}
+
+
+def _run(**kw):
+    cfg = Config(progress=False, **kw).validate()
+    return run_simulation(cfg, printer=ProgressPrinter(enabled=False))
+
+
+# Captured at the pre-scenario HEAD (f3e7221) on the tier-1 CPU host:
+# the -scenario off trajectories must stay bit-identical to these.
+PRE_SCENARIO_FP = {
+    "jax_event_si": {"windows": 9, "final": [90, 2928, 12791, 125, 0],
+                     "hash": "477b07759900a563"},
+    "sharded_event_si": {"windows": 10,
+                         "final": [100, 3890, 18320, 204, 0],
+                         "hash": "b8c00f159feac434"},
+}
+
+CHURN = ('{"groups": 2, "downtime": 60, "events": ['
+         '{"type": "churn", "start": 0, "end": 150, "rate": 2.0},'
+         '{"type": "crash", "at": 30, "frac": 0.3, "group": 1},'
+         '{"type": "partition", "start": 20, "end": 60}]}')
+
+
+# --------------------------------------------------------------------------
+# Parsing / validation
+# --------------------------------------------------------------------------
+
+def test_parse_off_and_inline_and_file(tmp_path):
+    assert scen_mod.parse("off") is scen_mod.OFF
+    assert scen_mod.parse("") is scen_mod.OFF
+    assert not scen_mod.OFF.active
+    s = scen_mod.parse(CHURN)
+    assert s.active and s.has_faults and s.has_partitions
+    assert s.downtime == 60 and s.groups == 2
+    assert len(s.churns) == 1 and len(s.crashes) == 1
+    p = tmp_path / "timeline.json"
+    p.write_text(CHURN)
+    assert scen_mod.parse(str(p)) == s
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("{not json", "invalid"),
+    ("/nonexistent/timeline.json", "neither"),
+    ('{"bogus": 1}', "unknown keys"),
+    ('{"events": [{"type": "crash", "frac": 0.5}]}', "missing field"),
+    ('{"events": [{"type": "crash", "at": 5, "frac": 2.0}]}', "frac"),
+    ('{"events": [{"type": "warp", "at": 5}]}', "unknown type"),
+    ('{"events": [{"type": "churn", "start": 9, "end": 3, "rate": 1}]}',
+     "nonempty"),
+    ('{"groups": 2, "events": [{"type": "crash", "at": 1, "frac": 0.1, '
+     '"group": 5}]}', "outside"),
+    ('{"events": [{"type": "partition", "start": 0, "end": 9}]}',
+     "groups >= 2"),
+])
+def test_parse_rejects(spec, msg):
+    with pytest.raises(ValueError, match=msg):
+        scen_mod.parse(spec)
+
+
+def test_config_gates():
+    with pytest.raises(ValueError, match="backend"):
+        Config(scenario='{"downtime": 5}', backend="native").validate()
+    with pytest.raises(ValueError, match="push-pull|pushpull"):
+        Config(scenario='{"downtime": 5}',
+               protocol="pushpull").validate()
+    with pytest.raises(ValueError, match="unsound"):
+        Config(scenario='{"downtime": 5}', crashrate=0.0,
+               dup_suppress="on").validate()
+    with pytest.raises(ValueError, match="friends table"):
+        Config(overlay_heal="on", protocol="pushpull").validate()
+    # Scenario faults silently force duplicate suppression off (auto).
+    cfg = Config(scenario='{"downtime": 5}', crashrate=0.0).validate()
+    assert not cfg.dup_suppress_resolved
+    assert cfg.faults_enabled
+    # A partition-only scenario is not a fault source: suppression stays.
+    cfg = Config(scenario='{"groups": 2, "events": [{"type": "partition",'
+                          '"start": 0, "end": 9}]}',
+                 crashrate=0.0).validate()
+    assert cfg.dup_suppress_resolved
+    assert not cfg.faults_enabled and cfg.scenario_resolved.has_partitions
+
+
+# --------------------------------------------------------------------------
+# -scenario off == pre-scenario HEAD, pinned
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,kw", [
+    ("jax_event_si", dict(n=3000, backend="jax")),
+    ("sharded_event_si", dict(n=4000, backend="sharded")),
+])
+def test_scenario_off_bit_identical_to_pre_scenario_head(name, kw):
+    cfg = Config(graph="kout", fanout=6, seed=3, crashrate=0.01,
+                 coverage_target=0.95, progress=False, **kw).validate()
+    assert cfg.scenario == "off"
+    assert _fingerprint(cfg) == PRE_SCENARIO_FP[name]
+
+
+def test_fault_machinery_without_events_is_trajectory_identical():
+    """downtime-only scenario at crashrate 0: the fault machinery is
+    TRACED (down_since carried, recovery checked every window) but no
+    crash ever happens, so nothing can recover -- the trajectory must
+    equal -scenario off exactly.  This is the A/B that catches the
+    machinery itself perturbing the physics.  (At crashrate > 0 a
+    downtime-only scenario legitimately CHANGES the run: reception
+    crashes reboot too -- the "machines reboot" model, covered by
+    test_crash_envelope_high_rate_with_and_without_recovery.)"""
+    base = dict(n=2000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                coverage_target=0.95)
+    off = _fingerprint(Config(progress=False, **base).validate())
+    armed = _fingerprint(Config(progress=False, scenario='{"downtime": 50}',
+                                **base).validate())
+    assert armed == off
+
+
+# --------------------------------------------------------------------------
+# Fault semantics
+# --------------------------------------------------------------------------
+
+def test_crash_wave_targets_group():
+    """A frac=1.0 wave on group 1 of 4 crashes exactly that contiguous id
+    range (minus anyone already crashed); the epidemic then counts them
+    as scenario crashes, not reception crashes."""
+    n = 2000
+    scen = ('{"groups": 4, "events": '
+            '[{"type": "crash", "at": 25, "frac": 1.0, "group": 1}]}')
+    r = _run(n=n, graph="kout", fanout=6, seed=3, crashrate=0.0,
+             coverage_target=0.99, max_rounds=300, scenario=scen)
+    assert r.stats.scen_crashed == n // 4
+    assert r.stats.total_crashed == 0
+    assert r.stats.scen_recovered == 0  # no downtime -> permanent
+
+
+def test_churn_and_recovery_counters():
+    scen = ('{"downtime": 40, "events": '
+            '[{"type": "churn", "start": 0, "end": 100, "rate": 1.0}]}')
+    r = _run(n=2000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+             coverage_target=0.99, max_rounds=400, scenario=scen)
+    s = r.stats
+    # rate 1.0/s over 100 ms ~ 10% expected churn; loose 4-sigma band.
+    assert 100 < s.scen_crashed < 320
+    # Crashes reboot 40 ms later -- except the tail whose downtime had
+    # not elapsed when the wave died and the run ended.
+    assert 0 < s.scen_recovered <= s.scen_crashed
+
+
+def test_partition_blackholes_cross_group_traffic():
+    """Full 2-way split for the whole run, seed fixed in one group: the
+    other group receives NOTHING, and every cross-group send is counted
+    in part_dropped."""
+    n = 2000
+    scen = ('{"groups": 2, "events": '
+            '[{"type": "partition", "start": 0, "end": 100000}]}')
+    for engine in ("auto", "ring"):
+        r = _run(n=n, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                 coverage_target=0.999, max_rounds=300, scenario=scen,
+                 engine=engine)
+        s = r.stats
+        assert s.part_dropped > 0
+        # The wave saturates one group only (half the nodes, +- the
+        # kout graph's cross-links all being blocked).
+        assert s.total_received <= n // 2
+        assert not r.converged
+
+
+def test_partition_window_then_heals():
+    """The same split for a finite window: traffic resumes after `end`
+    and the run converges (messages sent DURING the window are lost for
+    good -- send-time semantics)."""
+    scen = ('{"groups": 2, "events": '
+            '[{"type": "partition", "start": 0, "end": 60}]}')
+    r = _run(n=2000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+             coverage_target=0.99, max_rounds=2000, scenario=scen)
+    assert r.stats.part_dropped > 0
+    assert r.converged
+
+
+def test_scenario_draws_are_shard_count_invariant():
+    """The event engine's scenario stream is (window, GLOBAL-id)-keyed:
+    the S=1 jax run and the S=8 sharded run crash and recover the exact
+    same nodes at the same ticks (unlike the shard-folded delay/drop
+    streams, which diverge by design)."""
+    scen = ('{"groups": 4, "downtime": 80, "events": ['
+            '{"type": "churn", "start": 0, "end": 120, "rate": 1.5},'
+            '{"type": "crash", "at": 40, "frac": 0.5, "group": 2}]}')
+    base = dict(n=4000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                coverage_target=0.99, max_rounds=260, scenario=scen)
+    rj = _run(backend="jax", **base)
+    rs = _run(backend="sharded", **base)
+    assert rj.stats.scen_crashed == rs.stats.scen_crashed
+    assert rj.stats.scen_recovered == rs.stats.scen_recovered
+
+
+# --------------------------------------------------------------------------
+# Overlay self-healing
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["jax", "sharded"])
+def test_coverage_under_churn_heal_twins(backend):
+    """THE graceful-degradation acceptance shape (bench.py runs the same
+    twins at scale): >=20% steady churn with recovery plus a mid-run
+    partition.  With -overlay-heal on the run reaches the 99% target;
+    with it off the wave strands coverage well short."""
+    base = dict(n=3000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                coverage_target=0.99, max_rounds=600, scenario=CHURN,
+                backend=backend)
+    off = _run(**base)
+    on = _run(overlay_heal="on", **base)
+    assert on.converged, on.stats
+    assert on.stats.coverage >= 0.99
+    assert on.stats.heal_repaired > 0
+    assert not off.converged
+    assert off.stats.coverage < 0.97
+    assert off.stats.heal_repaired == 0
+    # >= 20% of nodes churned over the run.
+    assert on.stats.scen_crashed >= 0.2 * 3000
+
+
+def test_heal_ring_engine_matches_acceptance_shape():
+    base = dict(n=3000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                coverage_target=0.99, max_rounds=600, scenario=CHURN,
+                engine="ring")
+    on = _run(overlay_heal="on", **base)
+    assert on.converged and on.stats.heal_repaired > 0
+
+
+def test_heal_without_scenario_is_inert_on_fault_free_run():
+    """-overlay-heal on with nothing ever crashing: the detector never
+    condemns, the friends table never changes, and the run converges
+    like the plain one (same totals -- the heal pass is a no-op wave)."""
+    base = dict(n=2000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                coverage_target=0.95)
+    plain = _run(**base)
+    healed = _run(overlay_heal="on", **base)
+    assert healed.stats.heal_repaired == 0
+    assert healed.stats.total_received == plain.stats.total_received
+    assert healed.stats.total_message == plain.stats.total_message
+
+
+# --------------------------------------------------------------------------
+# Crash-path divergence envelope (models/event.py:34-51), with and
+# without recovery
+# --------------------------------------------------------------------------
+
+def test_crash_before_infect_ordering_pinned_both_engines():
+    """crashrate=1.0 pins the same-window crash-before-infect ordering
+    deterministically on BOTH engines: every reception's crash draw
+    fires, so no node is ever infected by a delivery -- coverage stays
+    at the seed alone and every reached node is crashed, in the exact
+    same counts run-to-run."""
+    base = dict(n=1000, graph="kout", fanout=6, seed=3, crashrate=1.0,
+                coverage_target=0.99, max_rounds=400)
+    for engine in ("auto", "ring"):
+        a = _run(engine=engine, **base)
+        b = _run(engine=engine, **base)
+        assert a.stats == b.stats  # deterministic
+        assert a.stats.total_received == 1  # the seed only
+        assert a.stats.total_crashed > 0
+        assert not a.converged
+
+
+def test_crash_envelope_high_rate_with_and_without_recovery():
+    """High crash rate (0.5/reception): the two engines' crash-path
+    divergences (per-message vs aggregated draws, same-window ordering)
+    stay inside a distributional envelope -- and the recovery path keeps
+    both deterministic and inside the same envelope while reviving
+    crashed nodes (scen_recovered > 0, coverage strictly above the
+    permanent-crash twin's)."""
+    base = dict(n=2000, graph="kout", fanout=8, seed=3, crashrate=0.5,
+                coverage_target=0.999, max_rounds=400)
+    ev = _run(engine="auto", **base)
+    rg = _run(engine="ring", **base)
+    for r in (ev, rg):
+        assert r.stats == _run(engine="auto" if r is ev else "ring",
+                               **base).stats  # deterministic
+    # Same physics, different crash-draw batching: totals agree within a
+    # loose distributional band.
+    assert abs(ev.stats.total_crashed - rg.stats.total_crashed) \
+        / max(rg.stats.total_crashed, 1) < 0.25
+    assert abs(ev.stats.total_received - rg.stats.total_received) \
+        / max(rg.stats.total_received, 1) < 0.25
+
+    recov = dict(base, scenario='{"downtime": 30}')
+    ev2 = _run(engine="auto", **recov)
+    rg2 = _run(engine="ring", **recov)
+    for with_rec, without in ((ev2, ev), (rg2, rg)):
+        assert with_rec.stats.scen_recovered > 0
+        # Reboots re-expose nodes to the wave: strictly more coverage
+        # than the permanent-black-hole twin.
+        assert with_rec.stats.total_received > without.stats.total_received
+
+
+# --------------------------------------------------------------------------
+# Telemetry: the scenario counters ride the device-resident history
+# --------------------------------------------------------------------------
+
+def test_scenario_counters_in_telemetry_history():
+    from gossip_simulator_tpu.backends import make_stepper
+
+    cfg = Config(n=2000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                 coverage_target=0.99, max_rounds=600, scenario=CHURN,
+                 overlay_heal="on", progress=False).validate()
+    s = make_stepper(cfg)
+    s.init()
+    s.seed()
+    s.run_to_target()
+    hist = s._telem.gossip_snapshot()
+    assert hist is not None
+    cols = hist["cols"]
+    count = hist["count"]
+    # scen_crashed / recovered / repaired / part_dropped columns are
+    # cumulative and end at the Stats values.
+    st = s.stats()
+    assert cols[count - 1, 9] == st.scen_crashed
+    assert cols[count - 1, 10] == st.scen_recovered
+    assert cols[count - 1, 11] == st.heal_repaired
+    assert cols[count - 1, 12] == st.part_dropped
+    assert st.scen_crashed > 0 and st.heal_repaired > 0
