@@ -166,6 +166,20 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
                    part_dropped=stats.part_dropped,
                    heal_repaired=stats.heal_repaired,
                    overlay_heal=cfg.overlay_heal)
+    if cfg.multi_rumor:
+        # Serving-workload metrics (ISSUE 8): coverage above is already the
+        # min-across-rumors; the throughput pair is the steady-state rate in
+        # the SIMULATED-time domain (wall-clock rates are the generic
+        # messages_per_sec above).
+        sim_s = s.sim_time_ms() / 1000.0
+        out.update(rumors=cfg.rumors, traffic=cfg.traffic,
+                   rumors_done=stats.rumors_done,
+                   rumor_min_recv=stats.rumor_min_recv,
+                   rumors_per_sim_sec=(round(stats.rumors_done / sim_s, 4)
+                                       if sim_s > 0 else None),
+                   deliveries_per_sim_sec=(round(
+                       stats.total_message / sim_s, 1)
+                       if sim_s > 0 else None))
     return out
 
 
@@ -494,6 +508,47 @@ def capture_churn_healing(detail: dict, seed: int,
             and on.get("scen_crashed", 0) >= 0.2 * n)
 
 
+def capture_multirumor(detail: dict, seed: int,
+                       n: int | None = None) -> None:
+    """Concurrent multi-rumor serving rows (ISSUE 8): a 1M-node R=16
+    oneshot broadcast (16 pipelined waves through ONE shared mailbox --
+    the marginal cost over the single-rumor row is the serving-workload
+    headline) and a 1M-node streaming run (R=64 injected at 100
+    rumors/simulated-second -- steady-state rumors/s and deliveries/s).
+    CPU hosts run the /100 twins (tests/test_multirumor.py pins the
+    small-n semantics)."""
+    if n is None:
+        n = 1_000_000 if jax.default_backend() == "tpu" else 10_000
+    base = Config(n=n, fanout=6, graph="kout", backend="jax", seed=seed,
+                  crashrate=0.0, coverage_target=0.95, max_rounds=3000,
+                  progress=False).validate()
+    for name, cfg in (
+        ("multirumor_1m_r16", base.replace(rumors=16)),
+        ("stream_1m", base.replace(rumors=64, traffic="stream",
+                                   stream_rate=100)),
+    ):
+        row = pool_retry(_bench_backend, cfg, name=name)
+        row["n"] = cfg.n
+        detail[name] = row
+
+
+def capture_multirumor_50m(detail: dict, seed: int) -> None:
+    """TPU-only 50M twin pair: the single-rumor baseline and the R=16
+    concurrent broadcast at the SAME n/graph/seed, so the record carries
+    the measured marginal cost of the rumor axis at scale (the bitmask
+    word ladder is 1 uint32/node at R<=32; the mail ring widens by W
+    payload words).  100M is intentionally NOT attempted: the R=16 mail
+    ring's extra word column sits too close to the 16 GB ceiling next to
+    the 1e8-node state (the 50M pair plus the 1-chip sharded twins bound
+    the projection)."""
+    base = Config(n=50_000_000, fanout=6, graph="kout", backend="jax",
+                  seed=seed, crashrate=0.0, coverage_target=0.95,
+                  max_rounds=3000, progress=False).validate()
+    for name, cfg in (("multirumor_50m_r1", base),
+                      ("multirumor_50m_r16", base.replace(rumors=16))):
+        detail[name] = pool_retry(_bench_backend, cfg, name=name)
+
+
 def capture_100m(detail: dict, seed: int, headline_n: int) -> None:
     """The 100M single-chip rows (BASELINE.md north-star scale), captured in
     the driver-recorded bench output rather than only in the README.
@@ -676,6 +731,9 @@ def main() -> int:
         # Coverage-under-churn heal twins (ISSUE 5 acceptance rows):
         # scale-banded like the suite (1M on TPU, /100 on CPU hosts).
         capture_churn_healing(result["detail"], args.seed)
+        # Multi-rumor serving rows (ISSUE 8): 1M R=16 oneshot + streaming
+        # injection, scale-banded the same way.
+        capture_multirumor(result["detail"], args.seed)
         if jax.default_backend() == "tpu":
             # Distributional validation of the Pallas generators on real
             # hardware (interpret-mode CI can only check structure); also
@@ -692,6 +750,9 @@ def main() -> int:
             capture_exchange_profile(result["detail"])
             capture_overlay_profile(result["detail"])
             capture_scale50(result["detail"], args.seed)
+            # 50M single- vs multi-rumor twins: the measured marginal
+            # cost of the rumor axis at scale (ISSUE 8).
+            capture_multirumor_50m(result["detail"], args.seed)
             # Refresh the salvage so a worker fault in the near-ceiling
             # 100M rows can't discard the just-measured sharded twins.
             with open(partial, "w") as fh:

@@ -135,8 +135,14 @@ def run_bounded_to_target(stepper) -> Stats:
         st = stepper.state
         from gossip_simulator_tpu.models.event import in_flight as _inflight
 
+        import jax.numpy as jnp
+
+        # Multi-rumor convergence is the WORST rumor: the loop runs until
+        # every rumor's per-rumor count reaches the target.
+        recv_metric = (jnp.min(st.rumor_recv[:cfg.rumors])
+                       if cfg.multi_rumor else st.total_received)
         tick, recv, in_flight = (int(x) for x in jax.device_get(
-            (st.tick, st.total_received, _inflight(st))))
+            (st.tick, recv_metric, _inflight(st))))
         if telem is not None:
             telem.tally_gossip_call(time.perf_counter() - t0)
         # Exhaustion is recorded whatever ends the run (the windowed loop's
@@ -145,9 +151,13 @@ def run_bounded_to_target(stepper) -> Stats:
         # "exhausted" -- reason parity with the windowed path.  Healing can
         # revive an empty ring (a pending dead-friend detection re-sends
         # from an already-infected healer), so heal-on runs never exit on
-        # emptiness -- they run to target or max_rounds.
+        # emptiness -- they run to target or max_rounds.  A streaming run
+        # with an empty ring is not dead while the injection schedule has
+        # rumors still to start.
         if (in_flight == 0 and cfg.protocol != "pushpull"
-                and not cfg.overlay_heal_resolved):
+                and not cfg.overlay_heal_resolved
+                and (not cfg.multi_rumor
+                     or tick > cfg.last_inject_tick)):
             stepper.exhausted = True
         if (recv >= target or tick >= cfg.max_rounds
                 or stepper.exhausted):

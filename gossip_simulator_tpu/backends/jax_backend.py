@@ -298,12 +298,16 @@ class JaxStepper(Stepper):
         extra = st.mail_dropped if hasattr(st, "mail_dropped") else 0
         rem = (event.removed_count(st)
                if self.cfg.protocol == "sir" else 0)
+        R = self.cfg.rumors
+        multi = self.cfg.multi_rumor
+        rmin = st.rumor_recv[:R].min() if multi else -1
+        rdone = (st.rumor_done[:R] >= 0).sum() if multi else 0
         (tm, tr, tc, trm, tick, dropped, in_flight, sc, sr, pd,
-         hr) = jax.device_get(
+         hr, rmin, rdone) = jax.device_get(
             (st.total_message, st.total_received, st.total_crashed,
              rem, st.tick, extra, event.in_flight(st),
              st.scen_crashed, st.scen_recovered, st.part_dropped,
-             st.heal_repaired))
+             st.heal_repaired, rmin, rdone))
         return Stats(
             n=self.cfg.n, round=int(tick),
             total_received=int(tr), total_message=msg64_value(tm),
@@ -312,6 +316,7 @@ class JaxStepper(Stepper):
             scen_crashed=int(sc), scen_recovered=int(sr),
             part_dropped=int(pd), heal_repaired=int(hr),
             exhausted=self.exhausted,
+            rumors=R, rumor_min_recv=int(rmin), rumors_done=int(rdone),
         ), int(in_flight)
 
     def stats(self) -> Stats:

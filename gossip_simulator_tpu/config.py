@@ -248,6 +248,26 @@ class Config:
     heal_detect_ms: int = 30
     # Print the end-of-run telemetry block (phase breakdown, throughput).
     telemetry_summary: bool = False
+    # --- multi-rumor traffic (ISSUE 8) ---------------------------------------
+    # Number of concurrent rumors sharing the dissemination substrate.  R=1
+    # (default) keeps every legacy single-rumor code path byte-for-byte: the
+    # rumor axis only materializes when multi_rumor resolves True.  R>1 adds
+    # a packed uint32 word ladder (W = ceil(R/32) words per node / per mail
+    # entry) and first-touch-wins becomes a per-rumor bitwise OR fold over
+    # the SAME mailbox/sort/rank/flat-scatter machinery -- no per-rumor loop.
+    rumors: int = 1
+    # "oneshot": all R rumors injected at tick 0 at R random sources, the run
+    # ends when every rumor reaches coverage_target (the classic wall-time
+    # measurement, now R-wide).  "stream": rumors are injected continuously
+    # from a jitted schedule at `stream_rate` rumors per 1000 simulated ms
+    # until all `rumors` are in flight; Stats/telemetry report steady-state
+    # throughput (rumors/s reaching the target, deliveries/s) instead of a
+    # single one-shot wall time.
+    traffic: str = "oneshot"
+    # Streaming injection rate: rumors per 1000 simulated ms (>= 1).  Rumor
+    # r is injected at tick r * 1000 // stream_rate at a derived-key uniform
+    # source, shard-count invariantly.
+    stream_rate: int = 100
 
     # --- derived --------------------------------------------------------------
     @property
@@ -336,7 +356,33 @@ class Config:
             return False
         if self.scenario_resolved.has_faults:
             return False
+        if self.multi_rumor:
+            # The "guaranteed duplicate" predicate (destination's received
+            # bit already set -- monotone) no longer implies zero-information
+            # delivery: an infected node can still gain NEW rumor bits from
+            # the entry's payload word.  validate() rejects an explicit "on".
+            return False
         return self.crashrate_eff == 0.0
+
+    @property
+    def multi_rumor(self) -> bool:
+        """Whether the rumor axis materializes (R > 1, or stream traffic --
+        a stream of 1 still needs per-rumor accounting).  Python-static: the
+        default single-rumor configuration never traces a rumor-axis op."""
+        return self.rumors > 1 or self.traffic == "stream"
+
+    @property
+    def rumor_word_count(self) -> int:
+        """uint32 words in the packed rumor ladder (W = ceil(R/32))."""
+        return (self.rumors + 31) // 32 if self.multi_rumor else 1
+
+    @property
+    def last_inject_tick(self) -> int:
+        """Tick of the final rumor's injection under stream traffic
+        (rumor r enters at r * 1000 // stream_rate); 0 for oneshot."""
+        if self.traffic != "stream":
+            return 0
+        return (self.rumors - 1) * 1000 // max(self.stream_rate, 1)
 
     @property
     def effective_time_mode(self) -> str:
@@ -532,6 +578,54 @@ class Config:
                 "entries would shift every later draw).  Note the "
                 "reference's own default crashrate 0.001 IS 0 under "
                 "-compat-reference (1%-resolution truncation).")
+        # --- multi-rumor traffic -----------------------------------------
+        if not 1 <= self.rumors <= 1024:
+            raise ValueError(
+                f"rumors must be in [1, 1024], got {self.rumors}")
+        if self.traffic not in ("oneshot", "stream"):
+            raise ValueError(
+                f"traffic must be oneshot|stream, got {self.traffic!r}")
+        if self.multi_rumor:
+            if self.backend not in ("jax", "sharded"):
+                raise ValueError(
+                    "-rumors > 1 / -traffic stream require backend=jax or "
+                    "sharded (the discrete-event oracles are single-rumor)")
+            if self.protocol != "si":
+                raise ValueError(
+                    "-rumors > 1 / -traffic stream support protocol=si only "
+                    "(SIR removal and push-pull digests are single-rumor)")
+            if self.effective_time_mode != "ticks":
+                raise ValueError(
+                    "-rumors > 1 / -traffic stream require -time-mode ticks")
+            if self.compat_reference:
+                raise ValueError(
+                    "-compat-reference is strictly single-rumor (the "
+                    "reference broadcasts exactly one rumor per run)")
+            if self.dup_suppress == "on":
+                raise ValueError(
+                    "-dup-suppress on is unsound with a rumor axis: an "
+                    "already-infected destination can still gain new rumor "
+                    "bits, so 'guaranteed duplicate' edges carry information")
+            if self.engine_resolved == "ring":
+                if self.backend == "sharded":
+                    raise ValueError(
+                        "-rumors > 1 on the ring engine is single-device "
+                        "only (use -engine event for -backend sharded)")
+                if self.overlay_heal_resolved:
+                    raise ValueError(
+                        "-overlay-heal with -rumors > 1 requires the event "
+                        "engine (ring-engine heal re-sends are single-rumor)")
+        if self.traffic == "stream":
+            if not 1 <= self.stream_rate <= 1_000_000:
+                # The upper bound keeps the injection schedule's clamped
+                # tick * rate product in int32 (event.injection_batch).
+                raise ValueError(
+                    f"stream_rate must be in [1, 1000000], got "
+                    f"{self.stream_rate}")
+            if self.engine_resolved != "event":
+                raise ValueError(
+                    "-traffic stream requires the event engine (the jitted "
+                    "injection schedule rides the event window step)")
         # --- fault-injection scenario ------------------------------------
         scen = self.scenario_resolved  # raises ValueError on a bad spec
         if scen.active:
@@ -744,6 +838,19 @@ def _build_parser() -> argparse.ArgumentParser:
                    dest="heal_detect_ms", type=int, default=d.heal_detect_ms,
                    help="ms of failed deliveries before a dead friend is "
                         "condemned and replaced")
+    p.add_argument("-rumors", "--rumors", type=int, default=d.rumors,
+                   help="concurrent rumors sharing one dissemination "
+                        "substrate (packed uint32 word ladder; 1 = the "
+                        "reference's single-rumor broadcast)")
+    p.add_argument("-traffic", "--traffic", choices=("oneshot", "stream"),
+                   default=d.traffic,
+                   help="oneshot: all rumors injected at tick 0; stream: "
+                        "continuous injection at -stream-rate with steady-"
+                        "state throughput reporting")
+    p.add_argument("-stream-rate", "--stream-rate", dest="stream_rate",
+                   type=int, default=d.stream_rate,
+                   help="stream traffic injection rate, rumors per 1000 "
+                        "simulated ms")
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

@@ -265,6 +265,9 @@ def _run(cfg: Config, printer: ProgressPrinter,
         "reason": None if converged else reason,
         **stats.to_dict(),
     }
+    if cfg.multi_rumor:
+        payload.update(_multi_rumor_report(cfg, stepper, stats,
+                                           coverage_ms))
     if telem is not None:
         payload["phases_s"] = {k: round(v, 6)
                                for k, v in sorted(telem.phases.items())}
@@ -279,6 +282,42 @@ def _run(cfg: Config, printer: ProgressPrinter,
         if cfg.telemetry_summary:
             printer.block(report.summary_block())
     return result
+
+
+def _multi_rumor_report(cfg: Config, stepper: Stepper, stats: Stats,
+                        coverage_ms: float) -> dict:
+    """Steady-state serving metrics for the terminal `result` record
+    (simulated-time domain; wall-clock throughput lives in the telemetry
+    report).  Per-rumor latency = rumor_done stamp minus the ANALYTIC
+    inject tick (rumor r starts at r * 1000 // stream_rate under
+    -traffic stream, tick 0 under oneshot) -- the schedule is
+    deterministic, so no per-rumor start stamp is carried on device."""
+    import jax
+    import numpy as np
+
+    R = cfg.rumors
+    done = np.asarray(jax.device_get(stepper.state.rumor_done))[:R]
+    inject = (np.arange(R, dtype=np.int64) * 1000 // cfg.stream_rate
+              if cfg.traffic == "stream" else np.zeros(R, np.int64))
+    out: dict = {"traffic": cfg.traffic}
+    secs = coverage_ms / 1000.0
+    if secs > 0:
+        out["rumors_per_sec"] = round(stats.rumors_done / secs, 4)
+        out["deliveries_per_sec"] = round(stats.total_message / secs, 1)
+    lat = (done.astype(np.int64) - inject)[done >= 0]
+    if lat.size:
+        out["rumor_latency_ms"] = {
+            "min": int(lat.min()), "max": int(lat.max()),
+            "p50": int(np.percentile(lat, 50)),
+            "p90": int(np.percentile(lat, 90)),
+            "mean": round(float(lat.mean()), 2),
+        }
+        counts, edges = np.histogram(lat, bins=min(10, max(1, lat.size)))
+        out["rumor_latency_hist"] = {
+            "edges_ms": [round(float(e), 1) for e in edges],
+            "counts": [int(c) for c in counts],
+        }
+    return out
 
 
 class _Checkpointer:

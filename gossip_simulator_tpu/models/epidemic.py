@@ -29,6 +29,7 @@ Distributionally negligible for small p; exact for c=1.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Callable
 
 import jax
@@ -56,12 +57,47 @@ def p_eff(cfg: Config, p: float) -> float:
     return int(p * 100) / 100.0 if cfg.compat_reference else p
 
 
+def init_rumor_leaves(cfg: Config, n: int):
+    """(pending_rumors, rumor_words, rumor_recv, rumor_done) -- full-size
+    under Config.multi_rumor, placeholders otherwise (the down_since
+    convention).  The ring engine stores per-rumor arrival counts over the
+    R axis directly (int32[d, n, R]) -- a scatter-ADD exists where a
+    scatter-OR does not, and R <= 1024 (validate) bounds the ring."""
+    if not cfg.multi_rumor:
+        return (jnp.zeros((1, 1, 1), I32), jnp.zeros((1, 1), jnp.uint32),
+                jnp.zeros((1,), I32), jnp.full((1,), -1, I32))
+    w = cfg.rumor_word_count
+    return (jnp.zeros((ring_depth(cfg), n, cfg.rumors), I32),
+            jnp.zeros((n, w), jnp.uint32),
+            jnp.zeros((w * 32,), I32), jnp.full((w * 32,), -1, I32))
+
+
+def unpack_rumor_bits(words: jnp.ndarray, r: int) -> jnp.ndarray:
+    """uint32 (n, W) word ladder -> bool (n, r) per-rumor bits."""
+    n, w = words.shape
+    bits = ((words[:, :, None]
+             >> jnp.arange(32, dtype=jnp.uint32)[None, None, :])
+            & jnp.uint32(1)).astype(bool).reshape(n, w * 32)
+    return bits[:, :r]
+
+
+def pack_rumor_bits(bits: jnp.ndarray, w: int) -> jnp.ndarray:
+    """bool (n, r) per-rumor bits -> uint32 (n, W) word ladder."""
+    n, r = bits.shape
+    padded = jnp.pad(bits, ((0, 0), (0, w * 32 - r)))
+    return (padded.reshape(n, w, 32).astype(jnp.uint32)
+            << jnp.arange(32, dtype=jnp.uint32)[None, None, :]).sum(
+        axis=2, dtype=jnp.uint32)
+
+
 def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
                n_local: int | None = None) -> SimState:
     n = n_local if n_local is not None else cfg.n
     d = ring_depth(cfg)
     d_rb = d if cfg.protocol == "sir" else 1
     z = lambda: jnp.zeros((), I32)
+    pending_rumors, rumor_words, rumor_recv, rumor_done = init_rumor_leaves(
+        cfg, n)
     return SimState(
         received=jnp.zeros((n,), bool),
         crashed=jnp.zeros((n,), bool),
@@ -76,6 +112,8 @@ def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
         down_since=_scen.init_down_since(cfg.faults_enabled, n),
         scen_crashed=z(), scen_recovered=z(), part_dropped=z(),
         heal_repaired=z(),
+        pending_rumors=pending_rumors, rumor_words=rumor_words,
+        rumor_recv=rumor_recv, rumor_done=rumor_done,
     )
 
 
@@ -117,8 +155,18 @@ def tick_core(cfg: Config, st: SimState, keys: dict):
     marks local rows broadcasting this tick, `dslot` is their target ring slot
     and `deltas = (d_message, d_received, d_crashed)` are LOCAL sums (callers
     psum them across shards before adding to the replicated totals).
+
+    Multi-rumor (Config.multi_rumor; SI, single-device only -- validate):
+    per-rumor arrivals drain from ``pending_rumors`` alongside the total
+    counts, a node's NEW bits (arrived, not crashed, not yet held) fold
+    into its rumor_words, `senders` becomes any-new-bit (an
+    already-infected node gaining a new rumor re-broadcasts), and the
+    return gains a trailing ``newbits`` bool (n, R) -- the payload the
+    caller deposits (deposit_rumors).  The 4-tuple return is unchanged
+    when multi is off.
     """
     sir = cfg.protocol == "sir"
+    multi = cfg.multi_rumor
     crash_p = p_eff(cfg, cfg.crashrate)
     d = ring_depth(cfg)
     n = st.received.shape[0]
@@ -149,10 +197,25 @@ def tick_core(cfg: Config, st: SimState, keys: dict):
     received = st.received | newly
     d_received = newly.sum(dtype=I32)
 
+    newbits = None
+    if multi:
+        arr_r = st.pending_rumors[slot]  # (n, R) per-rumor arrival counts
+        pending_r = st.pending_rumors.at[slot].set(0)
+        rbits = unpack_rumor_bits(st.rumor_words, cfg.rumors)
+        newbits = (arr_r > 0) & ~crashed[:, None] & ~rbits
+        rumor_words = st.rumor_words | pack_rumor_bits(
+            newbits, cfg.rumor_word_count)
+        rumor_recv = st.rumor_recv + jnp.pad(
+            newbits.sum(axis=0, dtype=I32),
+            (0, st.rumor_recv.shape[0] - cfg.rumors))
+        st = st._replace(pending_rumors=pending_r, rumor_words=rumor_words,
+                         rumor_recv=rumor_recv)
+
     # Dense per-row delay slots are only materialized when something consumes
-    # them for all n rows (SIR's re-broadcast scheduling, or the dense
-    # delivery path); the compact SI path draws slots per gathered row.
-    if sir or not cfg.compact_resolved:
+    # them for all n rows (SIR's re-broadcast scheduling, the dense
+    # delivery path, or the always-dense multi-rumor deposit); the compact
+    # SI path draws slots per gathered row.
+    if sir or multi or not cfg.compact_resolved:
         dslot = row_slot(cfg, keys["delay"], st.tick, ids)
     else:
         dslot = None
@@ -167,12 +230,15 @@ def tick_core(cfg: Config, st: SimState, keys: dict):
         rb = rb.at[dslot, ids].max(senders & ~removal)
     else:
         rb = st.rebroadcast
-        senders = newly
+        senders = newbits.any(axis=1) if multi else newly
         removed = st.removed
 
     st_partial = st._replace(
         received=received, crashed=crashed, removed=removed, pending=pending,
         rebroadcast=rb, tick=st.tick + 1)
+    if multi:
+        return st_partial, senders, dslot, (d_message, d_received,
+                                            d_crashed), newbits
     return st_partial, senders, dslot, (d_message, d_received, d_crashed)
 
 
@@ -324,6 +390,20 @@ def deposit_local(pending, dst_local, slots, valid):
     return pending.at[slots, dst].add(1, mode="drop")
 
 
+def deposit_rumors(pending_rumors, dst_local, slots, valid, newbits):
+    """Multi-rumor companion to deposit_local: each kept edge adds its
+    sender's NEW rumor bits (one-hot int rows) into the destination's
+    (slot, dst) per-rumor lane.  Same 2-D leading-index scatter form as
+    deposit_local (see the axon NOTE there); the R axis rides as the
+    scatter's trailing window dimension."""
+    n, r = newbits.shape
+    k = dst_local.shape[0] // n
+    vals = jnp.broadcast_to(newbits[:, None, :].astype(I32),
+                            (n, k, r)).reshape(n * k, r)
+    dst = jnp.where(valid, dst_local, pending_rumors.shape[1])
+    return pending_rumors.at[slots, dst].add(vals, mode="drop")
+
+
 def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     """Single-device per-tick transition for SI / SIR push gossip."""
 
@@ -334,20 +414,40 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     # (observed at n=2e5: pending gained cap*k counts per tick and the
     # epidemic stalled).  Root-caused 2026-07-30; the skip also measured no
     # wall-clock win (empty slots are rare once delays spread the wave).
+    multi = cfg.multi_rumor
+    if multi:
+        target = int(math.ceil(cfg.coverage_target * cfg.n))
+
     def tick_fn(st: SimState, base_key: jax.Array) -> SimState:
         st, dsc, dsr = apply_fault_window(
             cfg, st, jnp.arange(st.received.shape[0], dtype=I32), base_key)
         keys = tick_keys(base_key, st.tick)
-        stp, senders, dslot, (dm, dr, dc) = tick_core(cfg, st, keys)
-        if cfg.compact_resolved:
-            pending, blk = deposit_compact(
-                cfg, stp.pending, stp.friends, stp.friend_cnt, senders,
-                dslot, keys["delay"], keys["drop"], st.tick)
-        else:
+        if multi:
+            # Always-dense delivery: the compact gather has no per-rumor
+            # payload channel, and the multi configs are single-device
+            # (validate) where the dense path is the proven form.
+            stp, senders, dslot, (dm, dr, dc), newbits = tick_core(
+                cfg, st, keys)
             dst, slots, valid, blk = edges_from_senders(
                 cfg, stp.friends, stp.friend_cnt, senders, dslot,
                 keys["drop"], tick=st.tick)
             pending = deposit_local(stp.pending, dst, slots, valid)
+            stp = stp._replace(pending_rumors=deposit_rumors(
+                stp.pending_rumors, dst, slots, valid, newbits))
+            hit = (stp.rumor_recv >= target) & (stp.rumor_done < 0)
+            stp = stp._replace(rumor_done=jnp.where(
+                hit, stp.tick, stp.rumor_done))
+        else:
+            stp, senders, dslot, (dm, dr, dc) = tick_core(cfg, st, keys)
+            if cfg.compact_resolved:
+                pending, blk = deposit_compact(
+                    cfg, stp.pending, stp.friends, stp.friend_cnt, senders,
+                    dslot, keys["delay"], keys["drop"], st.tick)
+            else:
+                dst, slots, valid, blk = edges_from_senders(
+                    cfg, stp.friends, stp.friend_cnt, senders, dslot,
+                    keys["drop"], tick=st.tick)
+                pending = deposit_local(stp.pending, dst, slots, valid)
         stp = stp._replace(
             pending=pending,
             total_message=msg64_add(stp.total_message, dm),
@@ -366,7 +466,52 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
 def make_seed_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     """Uniform-random sender's initial broadcast (simulator.go:240-241).
     Unless compat_reference, the seed itself is marked received (the reference
-    never marks it -- SURVEY §5.4 quirk)."""
+    never marks it -- SURVEY §5.4 quirk).
+
+    Multi-rumor (oneshot only here -- stream requires the event engine):
+    all R sources draw from the shard-invariant OP_INJECT-by-rumor-index
+    streams (the event engine's injection_batch keying), their bits set
+    immediately, and every source broadcasts in ONE dense deposit."""
+    if cfg.multi_rumor:
+        r_total, w = cfg.rumors, cfg.rumor_word_count
+
+        def seed_multi(st: SimState, base_key: jax.Array) -> SimState:
+            n = st.received.shape[0]
+            rr = jnp.arange(r_total, dtype=I32)
+            ik = jax.random.fold_in(base_key, _rng.OP_INJECT)
+            srcs = jax.vmap(lambda q: jax.random.randint(
+                jax.random.fold_in(ik, q), (), 0, n, dtype=I32))(rr)
+            masks = jnp.where(
+                (rr[:, None] // 32) == jnp.arange(w, dtype=I32)[None, :],
+                (jnp.uint32(1) << (rr % 32).astype(jnp.uint32))[:, None],
+                jnp.uint32(0))
+            # Distinct bits never collide, so the scatter-ADD of colliding
+            # source rows IS their OR.
+            delta = jnp.zeros((n, w), jnp.uint32).at[srcs].add(masks)
+            is_src = (delta != jnp.uint32(0)).any(axis=1)
+            received = st.received | is_src
+            total_received = st.total_received + is_src.sum(dtype=I32)
+            rumor_recv = st.rumor_recv + (
+                jnp.arange(st.rumor_recv.shape[0], dtype=I32)
+                < r_total).astype(I32)
+            kd = _rng.tick_key(base_key, SEED_TICK, _rng.OP_DELAY)
+            kp = _rng.tick_key(base_key, SEED_TICK, _rng.OP_DROP)
+            dslot = row_slot(cfg, kd, st.tick, jnp.arange(n, dtype=I32))
+            dst, slots, valid, blk = edges_from_senders(
+                cfg, st.friends, st.friend_cnt, is_src, dslot, kp,
+                tick=st.tick)
+            pending = deposit_local(st.pending, dst, slots, valid)
+            pending_r = deposit_rumors(
+                st.pending_rumors, dst, slots, valid,
+                unpack_rumor_bits(delta, r_total))
+            if cfg.scenario_resolved.has_partitions:
+                st = st._replace(part_dropped=st.part_dropped + blk)
+            return st._replace(
+                received=received, total_received=total_received,
+                pending=pending, pending_rumors=pending_r,
+                rumor_words=st.rumor_words | delta, rumor_recv=rumor_recv)
+
+        return seed_multi
 
     def seed_fn(st: SimState, base_key: jax.Array) -> SimState:
         n = st.received.shape[0]
@@ -663,9 +808,16 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     # checks -- backends set `exhausted` only with healing off).
     check_in_flight = (cfg.protocol != "pushpull"
                        and not cfg.overlay_heal_resolved)
+    multi = cfg.multi_rumor
+    rumors = cfg.rumors
 
     def cond_live(s: SimState, target_count, until):
-        live = ((s.total_received < target_count)
+        if multi:
+            # Every rumor must hit the target; lanes >= R are padding.
+            recv = jnp.min(s.rumor_recv[:rumors])
+        else:
+            recv = s.total_received
+        live = ((recv < target_count)
                 & (s.tick < max_steps) & (s.tick < until))
         if check_in_flight:
             # In-flight term (an O(d*n) emptiness test per window, not
@@ -700,7 +852,8 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
             def body(carry):
                 s, h = carry
                 s = run_window(s, base_key)
-                return s, telem.record(h, telem.gossip_probe(s, sir))
+                return s, telem.record(h, telem.gossip_probe(
+                    s, sir, rumors=rumors if multi else 0))
 
             return jax.lax.while_loop(cond, body, (st, hist))
 

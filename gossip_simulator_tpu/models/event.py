@@ -131,6 +131,18 @@ class EventState(NamedTuple):
     scen_recovered: jnp.ndarray  # int32[]
     part_dropped: jnp.ndarray  # int32[]
     heal_repaired: jnp.ndarray  # int32[]
+    # --- multi-rumor traffic (Config.multi_rumor; placeholders otherwise,
+    # the down_since convention -- the single-rumor program never traces a
+    # rumor-axis op) ------------------------------------------------------
+    # Per-entry payload words, same flat ring layout/length as mail_ids:
+    # the entry at flat position p carries the W = ceil(R/32) uint32 rumor
+    # bits mail_words[p] (the sender's NEW bits at send time).
+    mail_words: jnp.ndarray  # uint32[dw * cap + ring_tail, W | 1x1]
+    rumor_words: jnp.ndarray  # uint32[n, W | 1x1]  per-node infection bits
+    # Per-rumor infected counts / completion tick, padded to W*32 lanes
+    # (lanes >= R stay 0 / -1).  Replicated across shards (psum'd deltas).
+    rumor_recv: jnp.ndarray  # int32[W * 32 | 1]
+    rumor_done: jnp.ndarray  # int32[W * 32 | 1]  tick coverage hit, -1 else
 
 
 def batch_ticks(cfg: Config, n_local: int | None = None) -> int:
@@ -284,10 +296,94 @@ def _chunk_want(cfg: Config, n_local: int | None = None) -> int:
     return max(256, want)
 
 
+def init_rumor_leaves(cfg: Config, n: int, ring_len: int | None = None):
+    """(mail_words, rumor_words, rumor_recv, rumor_done) -- full-size under
+    Config.multi_rumor, 1-element placeholders otherwise (the down_since
+    convention).  Shared by the single-device and sharded init paths and by
+    the checkpoint loader's legacy-snapshot backfill."""
+    if not cfg.multi_rumor:
+        return (jnp.zeros((1, 1), jnp.uint32), jnp.zeros((1, 1), jnp.uint32),
+                jnp.zeros((1,), I32), jnp.full((1,), -1, I32))
+    w = cfg.rumor_word_count
+    if ring_len is None:
+        ring_len = ring_windows(cfg) * slot_cap(cfg, n) + ring_tail(cfg, n)
+    return (jnp.zeros((ring_len, w), jnp.uint32),
+            jnp.zeros((n, w), jnp.uint32),
+            jnp.zeros((w * 32,), I32), jnp.full((w * 32,), -1, I32))
+
+
+def injection_lanes(cfg: Config) -> int:
+    """Static injection lane count per B-tick window: all R for oneshot
+    (only window 0's lanes validate), else the max rumors whose schedule
+    tick can land inside one window."""
+    if not cfg.multi_rumor:
+        return 0
+    if cfg.traffic != "stream":
+        return cfg.rumors
+    b = batch_ticks(cfg)
+    return min(cfg.rumors, (b * cfg.stream_rate + 999) // 1000 + 1)
+
+
+def injection_batch(cfg: Config, tick, base_key, b: int, dw: int,
+                    n_local: int | None = None, shard=None):
+    """Rumor injections whose schedule tick lands in the window
+    [tick, tick+b): self-addressed mail entries (dst = source row,
+    delivered at the rumor's inject tick) so injected rumors enter through
+    the SAME ring/drain machinery as every relayed message -- the source
+    is counted infected, and broadcasts, at its entry's drain.  Rumor r's
+    tick is 0 (oneshot: every rumor at window 0) or r * 1000 //
+    stream_rate (stream).  Source draws are keyed by rumor index ONLY
+    (OP_INJECT -- no tick, no shard), so the schedule is shard-count
+    invariant; `shard` non-None keeps only lanes the shard owns and
+    localizes the destination row.  Returns (payload, words, wslot,
+    valid) with injection_lanes(cfg) static lanes."""
+    m = injection_lanes(cfg)
+    r_total = cfg.rumors
+    w = cfg.rumor_word_count
+    stream = cfg.traffic == "stream"
+    if stream:
+        rate = cfg.stream_rate
+        # Clamp before the multiply so tick * rate stays in int32 at any
+        # max_rounds (past last_inject_tick every lane invalidates anyway;
+        # validate() bounds stream_rate so the clamped product fits).
+        tickc = jnp.minimum(tick, cfg.last_inject_tick + 1)
+        r0 = (tickc * rate + 999) // 1000
+        rr = r0 + jnp.arange(m, dtype=I32)
+        t_r = rr * 1000 // rate
+    else:
+        rr = jnp.arange(m, dtype=I32)
+        t_r = jnp.zeros((m,), I32)
+    valid = (rr < r_total) & (t_r >= tick) & (t_r < tick + b)
+    ik = jax.random.fold_in(base_key, _rng.OP_INJECT)
+    src = jax.vmap(lambda r: jax.random.randint(
+        jax.random.fold_in(ik, r), (), 0, cfg.n, dtype=I32))(rr)
+    if shard is not None:
+        valid = valid & (src // n_local == shard)
+        src = src % n_local
+    payload = src * b + t_r % b
+    wslot = (t_r // b) % dw
+    words = jnp.where(
+        (rr[:, None] // 32) == jnp.arange(w, dtype=I32)[None, :],
+        (jnp.uint32(1) << (rr % 32).astype(jnp.uint32))[:, None],
+        jnp.uint32(0))
+    return payload, words, wslot, valid
+
+
+def stamp_rumor_done(cfg: Config, rumor_recv, rumor_done, tick):
+    """Per-window completion stamping (metrics only -- the run cond keys on
+    rumor_recv): rumor r is done at the first window-end tick where its
+    infected count reaches the static ceil(coverage_target * n)."""
+    target = int(math.ceil(cfg.coverage_target * cfg.n))
+    hit = (rumor_recv >= target) & (rumor_done < 0)
+    return jnp.where(hit, tick, rumor_done)
+
+
 def init_state(cfg: Config, friends: jnp.ndarray,
                friend_cnt: jnp.ndarray) -> EventState:
     n = friends.shape[0]  # local rows: the shard slice under the sharded backend
     z = lambda: jnp.zeros((), I32)
+    mail_words, rumor_words, rumor_recv, rumor_done = init_rumor_leaves(
+        cfg, n)
     return EventState(
         flags=jnp.zeros((n,), jnp.uint8),
         friends=friends,
@@ -303,6 +399,8 @@ def init_state(cfg: Config, friends: jnp.ndarray,
         down_since=_scen.init_down_since(cfg.faults_enabled, n),
         scen_crashed=z(), scen_recovered=z(), part_dropped=z(),
         heal_repaired=z(),
+        mail_words=mail_words, rumor_words=rumor_words,
+        rumor_recv=rumor_recv, rumor_done=rumor_done,
     )
 
 
@@ -318,7 +416,8 @@ def _sender_keys(base_key, op: int, ticks, rows):
 
 def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
                     svalid, sticks, friends, friend_cnt, base_key,
-                    strig=None, flags=None, gid0=0):
+                    strig=None, flags=None, gid0=0, swords=None,
+                    mail_words=None):
     """Emit each sender's broadcast (k sends, ONE shared delay drawn at its
     delivery tick -- simulator.go:141-142) into the packed mail ring.
 
@@ -353,7 +452,13 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     a tagged self-message (trigger_base + id*b + off) arriving with the SAME
     shared delay -- the event analog of the ring engine's
     `rebroadcast.at[dslot, ids]` (models/epidemic.py tick_core); it sits
-    right after the sender's kept edges."""
+    right after the sender's kept edges.
+
+    Multi-rumor (`swords` (m, W) + `mail_words` set): every kept edge also
+    writes the sender's delta words through the SAME flat positions --
+    entry alignment is by construction, not by a second rank pass -- and
+    the return gains the updated mail_words.  Mutually exclusive with
+    `strig` (multi-rumor is SI-only, config.validate)."""
     n, k = friends.shape
     dw = ring_windows(cfg)
     cap = (mail_ids.shape[0] - ring_tail(cfg, n)) // dw
@@ -442,6 +547,13 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
                      dw * cap + lane)
     mail_ids = mail_ids.at[flat.reshape(-1)].set(
         jnp.where(edge, payload, 0).reshape(-1), unique_indices=True)
+    if swords is not None:
+        wvals = jnp.where(edge[:, :, None],
+                          jnp.broadcast_to(swords[:, None, :],
+                                           edge.shape + swords.shape[-1:]),
+                          jnp.uint32(0))
+        mail_words = mail_words.at[flat.reshape(-1)].set(
+            wvals.reshape(-1, swords.shape[-1]), unique_indices=True)
     # Overflowed senders are a per-slot suffix (start grows monotonically
     # within a slot), so counting only written reservations keeps
     # positions contiguous.
@@ -456,6 +568,9 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     # under SIR should be treated as an undersized -event-slot-cap, not as
     # ordinary message loss (see README divergence table).  blocked_n is
     # the partition-masked edge count (a Python 0 without partitions).
+    if swords is not None:
+        return mail_ids, new_cnt, dropped + lost, sup_adds, blocked_n, \
+            mail_words
     return mail_ids, new_cnt, dropped + lost, sup_adds, blocked_n
 
 
@@ -519,7 +634,7 @@ def predrain_compact(b: int, n_rows: int, dw: int, cap: int, ccap: int,
 def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
                      evalid, entry_pos, ckey, sir: bool = False,
                      track_crashed: bool = False, down_since=None,
-                     win_tick=None):
+                     win_tick=None, words=None, rumor_words=None):
     """Crash/infect/dedupe one drained chunk of packed entries (shared by the
     single-device and sharded engines; `n_rows` is the local row count).
 
@@ -553,6 +668,21 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     crashes (the scenario reboot/detection timeline; window-start
     granularity -- the crash draw itself is window-batched already).
 
+    Multi-rumor (`words` (ccap, W) + `rumor_words` set; SI only): the
+    entry payload words ride the sort as extra operands, a reversed
+    segmented OR-scan folds each node-run's words into its FIRST lane
+    (suffix-OR over the run -- read only at run starts), and the winner's
+    NEW bits (run OR minus the node's current words) update rumor_words,
+    per-rumor counts, and become the node's forwarded payload.  The
+    winner gate drops `~pre_recv`: an already-infected node gaining new
+    bits still delivers and re-forwards (first-touch-wins is per RUMOR,
+    not per node).  `dm` still counts every delivered entry -- a delivery
+    bringing no new bits walks the channel like any reference duplicate.
+    A crash draw firing at the run's first lane voids the whole run's
+    delivery (crashed-before-infected, the single-rumor rule, now
+    per-run).  Returns three extra values (rumor_words, delta_words,
+    drecv) and `senders` becomes win & (delta != 0).
+
     Returns (flags, dm, dr, dc, ids_s, toff_s, senders, down_since);
     senders is newly-infected for SI, newly | firing for SIR (disjoint: a
     trigger implies the node was already infected)."""
@@ -560,6 +690,12 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     tb = trigger_base(n_rows, b)
     sentinel = tb + n_rows * b if sir else n_rows * b
     packed = jnp.where(evalid, packed, sentinel)  # sentinel sorts last
+    wcols = ()
+    if words is not None:
+        # Stale ring lanes past the count carry garbage words: zero them
+        # (their sentinel keys sort them into non-data runs anyway).
+        words = jnp.where(evalid[:, None], words, jnp.uint32(0))
+        wcols = tuple(words[:, i] for i in range(words.shape[1]))
     if crash_p > 0.0:
         ck = _rng.row_keys(ckey, entry_pos)
         draw = jax.vmap(lambda kk: jax.random.bernoulli(kk, crash_p))(ck)
@@ -573,13 +709,20 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
         # guarantees span*b < 2^31, hence span*2b < 2^32 exactly.
         comb = (packed // b).astype(jnp.uint32) * jnp.uint32(2 * b) \
             + sub.astype(jnp.uint32)
-        comb_s = jax.lax.sort(comb)
+        if words is not None:
+            comb_s, *wcols_s = jax.lax.sort((comb,) + wcols, num_keys=1)
+        else:
+            comb_s = jax.lax.sort(comb)
         key1_s = (comb_s // jnp.uint32(2 * b)).astype(I32) * b
         sub_s = (comb_s % jnp.uint32(2 * b)).astype(I32)
         toff_s = sub_s % b
         crash_s = sub_s < b
     else:
-        packed_s = jnp.sort(packed)
+        if words is not None:
+            packed_s, *wcols_s = jax.lax.sort((packed,) + wcols,
+                                              num_keys=1)
+        else:
+            packed_s = jnp.sort(packed)
         key1_s = packed_s // b * b
         toff_s = packed_s % b
         crash_s = jnp.zeros((ccap,), bool)
@@ -628,6 +771,38 @@ def drain_chunk_core(crash_p: float, b: int, n_rows: int, flags, packed,
     if sir:
         fire = is_trig & pre_recv & ~pre_crash & ~((pre & REMOVED) > 0)
         senders = newly | fire
+    if words is not None:
+        words_s = jnp.stack(wcols_s, axis=1)
+        # Reversed segmented OR-scan: reversing keeps runs contiguous and
+        # turns each run's LAST lane into its segment start, so the
+        # inclusive scan leaves the whole-run OR at the run's original
+        # FIRST lane (the winner; other lanes hold suffix-ORs, unread).
+        last = jnp.concatenate([key1_s[:-1] != key1_s[1:],
+                                jnp.ones((1,), bool)])
+
+        def _seg_or(a, c):
+            af, av = a
+            cf, cv = c
+            return af | cf, jnp.where(cf[..., None], cv, av | cv)
+
+        _, rv = jax.lax.associative_scan(
+            _seg_or, (last[::-1], words_s[::-1]))
+        run_or = rv[::-1]
+        win = first & counted & ~crash_s  # newly minus the ~pre_recv gate
+        idxw = jnp.where(win, ids_s, n_rows)
+        old = rumor_words.at[jnp.minimum(idxw, n_rows - 1)].get()
+        delta_w = jnp.where(win[:, None], run_or & ~old, jnp.uint32(0))
+        rumor_words = rumor_words.at[idxw].set(
+            jnp.where(win[:, None], old | delta_w, jnp.uint32(0)),
+            mode="drop")
+        drecv = jnp.concatenate([
+            ((delta_w[:, wi][:, None]
+              >> jnp.arange(32, dtype=jnp.uint32)[None, :])
+             & jnp.uint32(1)).astype(I32).sum(axis=0)
+            for wi in range(words.shape[1])])
+        senders = win & (delta_w != 0).any(axis=1)
+        return (flags, dm, dr, dc, ids_s, toff_s, senders, down_since,
+                rumor_words, delta_w, drecv)
     return flags, dm, dr, dc, ids_s, toff_s, senders, down_since
 
 
@@ -737,13 +912,14 @@ def run_narrow_tail(make_abody, carry, count, scap: int):
 
 
 def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb,
-                 lo=None):
+                 lo=None, sdelta=None):
     """Extract compacted sender batch `jb`: rows with rank in
     [lo, lo+scap) land at rank-relative positions via one packed
     scatter (in-bounds trash cell at scap, sliced off).  `lo` defaults
     to jb*scap (uniform batches); the narrow-tail path passes the
     absolute start rank.  Returns (sids, stoff, svalid) of static width
-    scap."""
+    scap; with `sdelta` (multi-rumor per-lane payload words, (ccap, W))
+    a fourth value carries each compacted sender's word row."""
     if lo is None:
         lo = jb * scap
     pos = srank - lo
@@ -754,6 +930,10 @@ def sender_batch(senders, srank, scnt, spacked, b: int, scap: int, jb,
     sids = buf // b
     stoff = buf - sids * b
     svalid = jnp.arange(scap, dtype=I32) < (scnt - lo)
+    if sdelta is not None:
+        bufw = jnp.zeros((scap + 1, sdelta.shape[1]), jnp.uint32).at[
+            idx].set(jnp.where(sel[:, None], sdelta, jnp.uint32(0)))[:scap]
+        return sids, stoff, svalid, bufw
     return sids, stoff, svalid
 
 
@@ -810,11 +990,31 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
     track_crashed = faults or scen.has_faults
     track_down = faults and crash_p > 0.0
     track_part = scen.has_partitions
+    # Multi-rumor (static): entry payload words ride the carry alongside
+    # mail_ids; injection replaces the seed.  Off => every gate below is
+    # Python-False and the traced program is the single-rumor one.
+    multi = cfg.multi_rumor
+    if multi:
+        from gossip_simulator_tpu.ops.mailbox import ring_append
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.flags.shape[0]
         w = st.tick // b
         slot = w % dw
+        if multi:
+            # Streaming/oneshot injection: self-addressed source entries
+            # appended BEFORE the slot count is read, so a rumor due this
+            # window drains -- and its source starts forwarding -- this
+            # window.  make_seed_fn is an identity under multi.
+            ipay, iwords, iwslot, ivalid = injection_batch(
+                cfg, st.tick, base_key, b, dw)
+            icap = (st.mail_ids.shape[0] - tail) // dw
+            (mi, mw), icnt, idrop = ring_append(
+                (st.mail_ids, st.mail_words), st.mail_cnt,
+                st.mail_dropped, (ipay, iwords), iwslot, ivalid, dw,
+                icap)
+            st = st._replace(mail_ids=mi, mail_words=mw, mail_cnt=icnt,
+                             mail_dropped=idrop)
         m = st.mail_cnt[0, slot]
         dm0 = st.sup_cnt[0, slot]
         mail0 = st.mail_ids
@@ -843,13 +1043,13 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
         # loop only when reception crashes can stamp it, the partition
         # counter only when partitions exist -- the scenario-off carry is
         # the pre-scenario tuple exactly.
-        def pack(core, down, part):
+        def pack(core, down, part, mt=()):
             c = list(core)
             if track_down:
                 c.append(down)
             if track_part:
                 c.append(part)
-            return tuple(c)
+            return tuple(c) + tuple(mt)
 
         def unpack(c):
             core, i = c[:8], 8
@@ -857,23 +1057,38 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
             if track_down:
                 down, i = c[i], i + 1
             if track_part:
-                part = c[i]
-            return core, down, part
+                part, i = c[i], i + 1
+            return core, down, part, c[i:]
 
         def body(j, carry):
             (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
-             dropped), down, part = unpack(carry)
+             dropped), down, part, mt = unpack(carry)
+            mail_words = rumor_words = rrecv = delta_w = None
+            if multi:
+                mail_words, rumor_words, rrecv = mt
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
             cap = (mail_ids.shape[0] - tail) // dw
             packed = jax.lax.dynamic_slice(
                 mail_ids, (slot * cap + off0,), (ccap,))
-            flags, cdm, cdr, cdc, ids_s, toff_s, senders, down = \
-                drain_chunk_core(crash_p, b, n, flags, packed, evalid,
-                                 entry_pos, ckey, sir=sir,
-                                 track_crashed=track_crashed,
-                                 down_since=down, win_tick=st.tick)
+            if multi:
+                wchunk = jax.lax.dynamic_slice(
+                    mail_words, (slot * cap + off0, 0),
+                    (ccap, mail_words.shape[1]))
+                (flags, cdm, cdr, cdc, ids_s, toff_s, senders, down,
+                 rumor_words, delta_w, drecv) = drain_chunk_core(
+                    crash_p, b, n, flags, packed, evalid, entry_pos,
+                    ckey, sir=sir, track_crashed=track_crashed,
+                    down_since=down, win_tick=st.tick, words=wchunk,
+                    rumor_words=rumor_words)
+                rrecv = rrecv + drecv
+            else:
+                flags, cdm, cdr, cdc, ids_s, toff_s, senders, down = \
+                    drain_chunk_core(crash_p, b, n, flags, packed, evalid,
+                                     entry_pos, ckey, sir=sir,
+                                     track_crashed=track_crashed,
+                                     down_since=down, win_tick=st.tick)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
             if scap:
                 # Compact senders to <=scap-row batches (sender_batch),
@@ -886,16 +1101,21 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
 
                 def make_abody(width, lo_of):
                     def abody(jb, acarry):
+                        (aflags, amail_ids, amail_cnt, asup,
+                         adropped) = acarry[:5]
+                        i = 5
+                        apart = awords = sw = None
                         if track_part:
-                            (aflags, amail_ids, amail_cnt, asup,
-                             adropped, apart) = acarry
+                            apart, i = acarry[i], i + 1
+                        if multi:
+                            awords = acarry[i]
+                            sids, stoff, svalid, sw = sender_batch(
+                                senders, srank, scnt, spacked, b, width,
+                                jb, lo=lo_of(jb), sdelta=delta_w)
                         else:
-                            (aflags, amail_ids, amail_cnt, asup,
-                             adropped) = acarry
-                            apart = None
-                        sids, stoff, svalid = sender_batch(
-                            senders, srank, scnt, spacked, b, width, jb,
-                            lo=lo_of(jb))
+                            sids, stoff, svalid = sender_batch(
+                                senders, srank, scnt, spacked, b, width,
+                                jb, lo=lo_of(jb))
                         stick2 = w * b + stoff
                         strig = None
                         if sir:
@@ -915,16 +1135,26 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                                 jnp.where(rem, sids, n)].add(
                                 REMOVED, mode="drop")
                             strig = svalid & ~rem
-                        (amail_ids, amail_cnt, adropped, sa,
-                         ablk) = append_messages(
-                            cfg, amail_ids, amail_cnt, adropped, sids,
-                            svalid, stick2, st.friends, st.friend_cnt,
-                            base_key, strig=strig,
-                            flags=aflags if suppress else None)
+                        if multi:
+                            (amail_ids, amail_cnt, adropped, sa, ablk,
+                             awords) = append_messages(
+                                cfg, amail_ids, amail_cnt, adropped,
+                                sids, svalid, stick2, st.friends,
+                                st.friend_cnt, base_key, swords=sw,
+                                mail_words=awords)
+                        else:
+                            (amail_ids, amail_cnt, adropped, sa,
+                             ablk) = append_messages(
+                                cfg, amail_ids, amail_cnt, adropped,
+                                sids, svalid, stick2, st.friends,
+                                st.friend_cnt, base_key, strig=strig,
+                                flags=aflags if suppress else None)
                         out = (aflags, amail_ids, amail_cnt,
                                asup + sa[None, :], adropped)
                         if track_part:
                             out = out + (apart + ablk,)
+                        if multi:
+                            out = out + (awords,)
                         return out
                     return abody
 
@@ -934,12 +1164,18 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                 acarry0 = (flags, mail_ids, mail_cnt, sup_cnt, dropped)
                 if track_part:
                     acarry0 = acarry0 + (part,)
+                if multi:
+                    acarry0 = acarry0 + (mail_words,)
                 out = run_narrow_tail(make_abody, acarry0, scnt, scap)
                 (flags, mail_ids, mail_cnt, sup_cnt, dropped) = out[:5]
                 if track_part:
                     part = out[5]
+                if multi:
+                    mail_words = out[-1]
                 return pack((flags, mail_ids, mail_cnt, sup_cnt, dm, dr,
-                             dc, dropped), down, part)
+                             dc, dropped), down, part,
+                            (mail_words, rumor_words, rrecv)
+                            if multi else ())
             sticks = w * b + toff_s
             strig = None
             if sir:
@@ -963,15 +1199,25 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
             # ~6-10% SLOWER at n=1e7/1e8 fanout 3 -- the 5-op selection
             # cost more than the 2.4x width saving; the 2-op rank-scatter
             # compaction above pays only at higher degree.)
-            mail_ids, mail_cnt, dropped, sa, blk = append_messages(
-                cfg, mail_ids, mail_cnt, dropped,
-                jnp.where(senders, ids_s, 0), senders, sticks,
-                st.friends, st.friend_cnt, base_key, strig=strig,
-                flags=flags if suppress else None)
+            if multi:
+                (mail_ids, mail_cnt, dropped, sa, blk,
+                 mail_words) = append_messages(
+                    cfg, mail_ids, mail_cnt, dropped,
+                    jnp.where(senders, ids_s, 0), senders, sticks,
+                    st.friends, st.friend_cnt, base_key,
+                    swords=delta_w, mail_words=mail_words)
+            else:
+                mail_ids, mail_cnt, dropped, sa, blk = append_messages(
+                    cfg, mail_ids, mail_cnt, dropped,
+                    jnp.where(senders, ids_s, 0), senders, sticks,
+                    st.friends, st.friend_cnt, base_key, strig=strig,
+                    flags=flags if suppress else None)
             if track_part:
                 part = part + blk
             return pack((flags, mail_ids, mail_cnt, sup_cnt + sa[None, :],
-                         dm, dr, dc, dropped), down, part)
+                         dm, dr, dc, dropped), down, part,
+                        (mail_words, rumor_words, rrecv)
+                        if multi else ())
 
         z = jnp.zeros((), I32)
         # Credit this window's deferred duplicate counts (banked by
@@ -979,12 +1225,16 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
         # would have counted; appends during this drain only target later
         # windows (delay >= B), so the slot accrues nothing new before the
         # zeroing below.
+        mt0 = ()
+        if multi:
+            mt0 = (st.mail_words, st.rumor_words,
+                   jnp.zeros_like(st.rumor_recv))
         out = jax.lax.fori_loop(
             0, chunks, body,
             pack((st.flags, mail0, st.mail_cnt, st.sup_cnt,
-                  dm0, z, z, st.mail_dropped), st.down_since, z))
+                  dm0, z, z, st.mail_dropped), st.down_since, z, mt0))
         (flags, mail_ids, mail_cnt, sup_cnt, dm, dr, dc,
-         dropped), down, part = unpack(out)
+         dropped), down, part, mt = unpack(out)
         mail_cnt = mail_cnt.at[0, slot].set(0)
         sup_cnt = sup_cnt.at[0, slot].set(0)
         st = st._replace(
@@ -994,6 +1244,19 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
             total_received=st.total_received + dr,
             total_crashed=st.total_crashed + dc,
             mail_dropped=dropped)
+        if multi:
+            # The drained slot's stale words are never zeroed: the next
+            # cycle's appends rewrite the [0, count) prefix and the drain
+            # zeroes words past the count (evalid gate in
+            # drain_chunk_core), so no stale word is ever read.
+            mail_words, rumor_words, rrecv = mt
+            rumor_recv = st.rumor_recv + rrecv
+            rumor_done = stamp_rumor_done(cfg, rumor_recv, st.rumor_done,
+                                          st.tick)
+            st = st._replace(mail_words=mail_words,
+                             rumor_words=rumor_words,
+                             rumor_recv=rumor_recv,
+                             rumor_done=rumor_done)
         if track_down:
             st = st._replace(down_since=down)
         if scen.active:
@@ -1011,7 +1274,17 @@ def make_seed_fn(cfg: Config):
     """Uniform-random sender's initial broadcast (simulator.go:240-241),
     through the same append path as every later wave.  Uses the ring
     engine's SEED_TICK-keyed streams: a dedicated one-sender append so the
-    seed's delay/drop draws do not depend on tick-0 window state."""
+    seed's delay/drop draws do not depend on tick-0 window state.
+
+    Multi-rumor: an identity -- sources are injected by the window step
+    itself (injection_batch appends self-addressed entries, so a source
+    counts as infected when its entry DRAINS, and oneshot lanes only
+    validate in window 0).  Backends still call seed() unconditionally."""
+    if cfg.multi_rumor:
+        def seed_noop(st: EventState, base_key: jax.Array) -> EventState:
+            return st
+
+        return seed_noop
 
     def seed_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.flags.shape[0]
@@ -1091,6 +1364,7 @@ def make_heal_fn(cfg: Config, n_local: int | None = None):
     b = batch_ticks(cfg, n_local)
     dw = ring_windows(cfg, n_local)
     detect = cfg.heal_detect_ms
+    multi = cfg.multi_rumor
 
     def heal_fn(st: EventState, base_key: jax.Array) -> EventState:
         n, k = st.friends.shape
@@ -1111,14 +1385,35 @@ def make_heal_fn(cfg: Config, n_local: int | None = None):
         off = (arrive % b)[:, None]
         payload = (friends * b + off).reshape(-1)
         cap = (st.mail_ids.shape[0] - ring_tail(cfg, n_local)) // dw
-        (mail,), cnt, dropped = ring_append(
-            (st.mail_ids,), st.mail_cnt, st.mail_dropped, (payload,),
-            wslot, resend.reshape(-1), dw, cap)
-        # Rejoin pull responses deliver to the puller's OWN row.
-        ppay = jnp.broadcast_to((ids * b)[:, None] + off, (n, k)).reshape(-1)
-        (mail,), cnt, dropped = ring_append(
-            (mail,), cnt, dropped, (ppay,), wslot, pull.reshape(-1), dw,
-            cap)
+        if multi:
+            wc = st.rumor_words.shape[1]
+            # Resends carry the healer's FULL rumor set; a churned node
+            # rejoin-pulls ALL of its friend's rumors (the per-rumor
+            # generalization of the single "infected" bit).
+            rw = jnp.broadcast_to(st.rumor_words[:, None, :],
+                                  (n, k, wc)).reshape(-1, wc)
+            (mail, mailw), cnt, dropped = ring_append(
+                (st.mail_ids, st.mail_words), st.mail_cnt,
+                st.mail_dropped, (payload, rw), wslot,
+                resend.reshape(-1), dw, cap)
+            ppay = jnp.broadcast_to((ids * b)[:, None] + off,
+                                    (n, k)).reshape(-1)
+            fw = st.rumor_words[jnp.where(friends >= 0, friends,
+                                          0)].reshape(-1, wc)
+            (mail, mailw), cnt, dropped = ring_append(
+                (mail, mailw), cnt, dropped, (ppay, fw), wslot,
+                pull.reshape(-1), dw, cap)
+            st = st._replace(mail_words=mailw)
+        else:
+            (mail,), cnt, dropped = ring_append(
+                (st.mail_ids,), st.mail_cnt, st.mail_dropped, (payload,),
+                wslot, resend.reshape(-1), dw, cap)
+            # Rejoin pull responses deliver to the puller's OWN row.
+            ppay = jnp.broadcast_to((ids * b)[:, None] + off,
+                                    (n, k)).reshape(-1)
+            (mail,), cnt, dropped = ring_append(
+                (mail,), cnt, dropped, (ppay,), wslot, pull.reshape(-1),
+                dw, cap)
         return st._replace(
             friends=friends, mail_ids=mail, mail_cnt=cnt,
             mail_dropped=dropped,
@@ -1172,16 +1467,34 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     # re-send from infected healers), so heal-on runs drop the early-death
     # exit (see epidemic.make_run_to_coverage_fn).
     check_in_flight = not cfg.overlay_heal_resolved
+    multi = cfg.multi_rumor
+    rumors = cfg.rumors
+    stream = cfg.traffic == "stream"
+    last_inj = cfg.last_inject_tick
 
     def cond_live(s: EventState, target_count, until):
         # The in-flight term (a dw-element emptiness test -- free) stops
         # the loop the moment the wave dies instead of spinning empty
         # windows to max_rounds (the host-side exhaustion check only
         # runs between bounded calls).
-        live = ((s.total_received < target_count)
+        if multi:
+            # Every rumor must hit the target; lanes >= R are padding
+            # (always 0), so the static [:R] slice is load-bearing.
+            recv = jnp.min(s.rumor_recv[:rumors])
+        else:
+            recv = s.total_received
+        live = ((recv < target_count)
                 & (s.tick < max_steps) & (s.tick < until))
         if check_in_flight:
-            live = live & (in_flight(s) > 0)
+            alive = in_flight(s) > 0
+            if multi:
+                # An empty ring is not death while the injection
+                # schedule still has rumors to start -- including tick 0
+                # of a oneshot run (last_inj = 0), where seeding happens
+                # INSIDE the first window step rather than before the
+                # loop (seed() is a no-op under the rumor axis).
+                alive = alive | (s.tick <= last_inj)
+            live = live & alive
         return live
 
     def run_window(s: EventState, base_key):
@@ -1206,7 +1519,8 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
             def body(carry):
                 s, h = carry
                 s = run_window(s, base_key)
-                return s, telem.record(h, telem.gossip_probe(s, sir))
+                return s, telem.record(h, telem.gossip_probe(
+                    s, sir, rumors=rumors if multi else 0))
 
             return jax.lax.while_loop(cond, body, (st, hist))
 

@@ -80,6 +80,15 @@ class SimState(NamedTuple):
     scen_recovered: jnp.ndarray  # int32[]  nodes rebooted after downtime
     part_dropped: jnp.ndarray  # int32[]  sends black-holed by partitions
     heal_repaired: jnp.ndarray  # int32[]  dead friend edges replaced
+    # --- multi-rumor traffic (Config.multi_rumor) ------------------------
+    # Packed per-rumor infection bits (W = ceil(R/32) uint32 words per
+    # node) and per-rumor arrival counts over the delay ring.  1-element
+    # placeholders when multi_rumor is off, so the default single-rumor
+    # build traces no rumor-axis op (the down_since convention).
+    pending_rumors: jnp.ndarray  # int32[d, n, R | 1x1x1]  per-rumor arrivals
+    rumor_words: jnp.ndarray  # uint32[n, W | 1x1]  per-node rumor bitmask
+    rumor_recv: jnp.ndarray  # int32[W*32 | 1]  per-rumor infected count
+    rumor_done: jnp.ndarray  # int32[W*32 | 1]  tick rumor hit target (-1)
 
 
 def in_flight(st) -> jnp.ndarray:

@@ -54,9 +54,12 @@ def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
 
     `rings`/`payloads` are equal-length tuples -- every ring gets the same
     flat positions, so multi-array entries (e.g. the overlay's (dst, pay)
-    pair) stay aligned.  Shared by parallel/event_sharded._ring_append and
-    models/overlay_ticks; models/event.append_messages keeps its own
-    multi-entry-per-row reservation variant."""
+    pair) stay aligned.  A ring may carry a trailing payload axis (the
+    multi-rumor (L, W) word ladder next to an (L,) id ring): its payload is
+    (M, W) and the shared flat positions scatter whole rows.  Shared by
+    parallel/event_sharded._ring_append and models/overlay_ticks;
+    models/event.append_messages keeps its own multi-entry-per-row
+    reservation variant."""
     oh = ((wslot[:, None] == jnp.arange(dw, dtype=jnp.int32)[None, :])
           & valid[:, None]).astype(jnp.int32)
     rank = (jnp.cumsum(oh, axis=0) * oh).sum(axis=1) - 1
@@ -64,8 +67,9 @@ def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
     pos = base + rank
     ok = valid & (pos < cap)
     flat = jnp.where(ok, wslot * cap + pos, dw * cap)  # in-bounds trash cell
-    rings = tuple(r.at[flat].set(jnp.where(ok, p, 0))
-                  for r, p in zip(rings, payloads))
+    rings = tuple(
+        r.at[flat].set(jnp.where(ok[:, None] if p.ndim == 2 else ok, p, 0))
+        for r, p in zip(rings, payloads))
     cnt = cnt + (oh * ok[:, None]).sum(axis=0)[None, :]
     dropped = dropped + (valid & ~ok).sum(dtype=jnp.int32)
     return rings, cnt, dropped

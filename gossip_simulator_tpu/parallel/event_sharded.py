@@ -77,7 +77,12 @@ DIRECT_SELF_APPEND = True      # S=1: skip the route (it is the identity)
 
 def event_state_specs(cfg: Config) -> EventState:
     # down_since: see sharded_step.sim_state_specs -- node-sharded only
-    # when the fault machinery allocates the full axis.
+    # when the fault machinery allocates the full axis.  The rumor leaves
+    # follow the same convention: the mail-ring words and per-node bitmask
+    # shard with their primary arrays under Config.multi_rumor; the
+    # 1-element placeholders (and the psum-replicated per-rumor counters)
+    # are replicated.
+    multi = cfg.multi_rumor
     return EventState(
         flags=P(AXIS),
         friends=P(AXIS, None), friend_cnt=P(AXIS),
@@ -87,6 +92,9 @@ def event_state_specs(cfg: Config) -> EventState:
         down_since=P(AXIS) if cfg.faults_enabled else P(),
         scen_crashed=P(), scen_recovered=P(), part_dropped=P(),
         heal_repaired=P(),
+        mail_words=P(AXIS, None) if multi else P(),
+        rumor_words=P(AXIS, None) if multi else P(),
+        rumor_recv=P(), rumor_done=P(),
     )
 
 
@@ -113,17 +121,24 @@ def make_sharded_event_init(cfg: Config, mesh):
 
 
 def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
-                 wslot, valid):
+                 wslot, valid, words=None, mail_words=None):
     """Append one packed entry per True in `valid` into its `wslot` slot of
     the local mail ring: rank within each slot via a one-hot cumsum
     (emission order), bounds-checked against the slot capacity with
     overflow counted in `dropped`, out-of-capacity writes diverted to the
     dw*cap trash cell.  The single reservation path for both routed data
-    messages and shard-local SIR triggers."""
+    messages and shard-local SIR triggers.  With `words`/`mail_words`
+    (multi-rumor) the per-entry payload words land at the SAME flat
+    positions and a 4th value returns the updated word ring."""
     from gossip_simulator_tpu.ops.mailbox import ring_append
 
     dw = event.ring_windows(cfg)
     cap = (mail.shape[0] - event.ring_tail(cfg, n_local)) // dw
+    if words is not None:
+        (mail, mail_words), cnt, dropped = ring_append(
+            (mail, mail_words), cnt, dropped, (payload, words), wslot,
+            valid, dw, cap)
+        return mail, cnt, dropped, mail_words
     (mail,), cnt, dropped = ring_append(
         (mail,), cnt, dropped, (payload,), wslot, valid, dw, cap)
     return mail, cnt, dropped
@@ -131,7 +146,7 @@ def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
 
 def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
                       dropped, xovf, dst_global, wslot, off, valid, rcap,
-                      flags=None):
+                      flags=None, words=None, mail_words=None):
     """Route (global dst, window slot, tick offset) messages to their owner
     shards and append into the local mail ring.
 
@@ -163,7 +178,18 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     stays structurally 0, which the zero-loss caps already guaranteed
     there (pinned by test_direct_local_matches_routed and the
     single-device bit-identity test).  Returns
-    (mail, cnt, dropped, xovf, sup_adds)."""
+    (mail, cnt, dropped, xovf, sup_adds).
+
+    Multi-rumor (`words` (M, W) uint32 + `mail_words`): each message's
+    payload words ride the SAME all_to_all as extra bitcast-int32 columns
+    (exchange.route_multi slot-aligns them with the wire word), and the
+    receive-side append lands them at the same flat ring positions
+    (_ring_append's words path).  The -1 wire sentinel gates validity on
+    the PRIMARY payload only, so word values with bit 31 set (rumor
+    indices = 31 mod 32) route unharmed.  `flags` (duplicate suppression)
+    is mutually exclusive with `words` -- config.validate rejects
+    -dup-suppress on under a rumor axis.  A 6th return value carries the
+    updated word ring."""
     b = event.batch_ticks(cfg)
     dw = event.ring_windows(cfg)
     sup_adds = jnp.zeros((dw,), I32)
@@ -184,6 +210,11 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
                     & dup[:, None]).sum(axis=0, dtype=I32)
         valid = valid & ~dup
     if direct:
+        if words is not None:
+            mail, cnt, dropped, mail_words = _ring_append(
+                cfg, n_local, mail, cnt, dropped, dst_global * b + off,
+                wslot, valid, words=words, mail_words=mail_words)
+            return mail, cnt, dropped, xovf, sup_adds, mail_words
         mail, cnt, dropped = _ring_append(
             cfg, n_local, mail, cnt, dropped, dst_global * b + off, wslot,
             valid)
@@ -192,7 +223,15 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     wire = jnp.where(
         valid,
         (dst_global % n_local) * (dw * b) + wslot * b + off, -1)
-    recv, ovf = exchange.route_one(wire, dest, valid, n_shards, rcap)
+    if words is not None:
+        payloads = (wire,) + tuple(
+            jax.lax.bitcast_convert_type(words[:, i], I32)
+            for i in range(words.shape[1]))
+        recvs, ovf = exchange.route_multi(payloads, dest, valid, n_shards,
+                                          rcap)
+        recv = recvs[0]
+    else:
+        recv, ovf = exchange.route_one(wire, dest, valid, n_shards, rcap)
     rvalid = recv >= 0
     r = jnp.maximum(recv, 0)
     rdstl = r // (dw * b)
@@ -207,6 +246,17 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
             (rw[:, None] == jnp.arange(dw, dtype=I32)[None, :])
             & dup[:, None]).sum(axis=0, dtype=I32)
         rvalid = rvalid & ~dup
+    if words is not None:
+        rwords = jnp.stack(
+            [jax.lax.bitcast_convert_type(c, jnp.uint32)
+             for c in recvs[1:]], axis=1)
+        # Empty wire slots carry the -1 fill in every column; the rvalid
+        # gate keeps their garbage words out of the ring.
+        rwords = jnp.where(rvalid[:, None], rwords, jnp.uint32(0))
+        mail, cnt, dropped, mail_words = _ring_append(
+            cfg, n_local, mail, cnt, dropped, rdstl * b + roff, rw,
+            rvalid, words=rwords, mail_words=mail_words)
+        return mail, cnt, dropped, xovf + ovf, sup_adds, mail_words
     mail, cnt, dropped = _ring_append(
         cfg, n_local, mail, cnt, dropped, rdstl * b + roff, rw, rvalid)
     return mail, cnt, dropped, xovf + ovf, sup_adds
@@ -268,6 +318,12 @@ def make_sharded_event_step(cfg: Config, mesh):
     track_crashed = faults or scen.has_faults
     track_down = faults and crash_p > 0.0
     track_part = scen.has_partitions
+    # Multi-rumor (static): entry payload words ride the wire/carry
+    # alongside mail_ids; injection replaces the seed (owner-gated --
+    # injection_batch's source draws are shard-count invariant).  Off =>
+    # every gate below is Python-False and the traced program is the
+    # single-rumor one.
+    multi = cfg.multi_rumor
 
     def step_shard(st: EventState, base_key: jax.Array) -> EventState:
         shard = jax.lax.axis_index(AXIS)
@@ -280,6 +336,24 @@ def make_sharded_event_step(cfg: Config, mesh):
             cfg, st.flags, st.down_since, st.tick,
             gid0 + jnp.arange(n_local, dtype=I32), base_key, b)
         st = st._replace(flags=flags1, down_since=down1)
+        inj_drop = jnp.zeros((), I32)
+        if multi:
+            # Streaming/oneshot injection BEFORE the slot count is read,
+            # so a rumor due this window drains -- and its source starts
+            # forwarding -- this window (the single-device step's order).
+            # Only the source's owner shard appends (valid is owner-gated,
+            # payload row localized); drops accumulate into the psum'd
+            # per-window delta, not the replicated mail_dropped directly.
+            ipay, iwords, iwslot, ivalid = event.injection_batch(
+                cfg, st.tick, base_key, b, dw, n_local=n_local,
+                shard=shard)
+            from gossip_simulator_tpu.ops.mailbox import ring_append
+
+            icap = (st.mail_ids.shape[0] - tail) // dw
+            (mi, mw), icnt, inj_drop = ring_append(
+                (st.mail_ids, st.mail_words), st.mail_cnt, inj_drop,
+                (ipay, iwords), iwslot, ivalid, dw, icap)
+            st = st._replace(mail_ids=mi, mail_words=mw, mail_cnt=icnt)
         w = st.tick // b
         slot = w % dw
         m = st.mail_cnt[0, slot]
@@ -315,13 +389,15 @@ def make_sharded_event_step(cfg: Config, mesh):
         cap = cap0
 
         def emit(flags, mail, cnt, dropped, xovf, sids, svalid, sticks,
-                 width, ecap):
+                 width, ecap, sw=None, mwords=None):
             """Route one batch of senders' broadcasts (delay/drop draws,
             SIR removal + local triggers, all_to_all + ring append) at a
             static `width`.  Keys are shard-folded + (tick, local-row)
             keyed, so the draws do not depend on the batch width.
             Returns a trailing partition-block count (Python 0 without
-            partitions)."""
+            partitions); under multi (`sw` = per-sender delta words
+            (width, W), `mwords` = word ring) a further trailing value
+            returns the updated word ring."""
             if s == 1 and DIRECT_SELF_APPEND and not sir:
                 # One-device SI mesh: the emission IS the single-device
                 # append -- append_messages draws the identical
@@ -337,6 +413,13 @@ def make_sharded_event_step(cfg: Config, mesh):
                 # with its edges -- a different (established, pre-round-6)
                 # ring order this rework must not shift.  The partition
                 # mask applies inside append_messages (gid0 globalizes).
+                if multi:
+                    mail, cnt, dropped, sa, blk, mwords = \
+                        event.append_messages(
+                            cfg, mail, cnt, dropped, sids, svalid, sticks,
+                            st.friends, st.friend_cnt, skey, gid0=gid0,
+                            swords=sw, mail_words=mwords)
+                    return flags, mail, cnt, dropped, xovf, sa, blk, mwords
                 mail, cnt, dropped, sa, blk = event.append_messages(
                     cfg, mail, cnt, dropped, sids, svalid, sticks,
                     st.friends, st.friend_cnt, skey,
@@ -387,6 +470,21 @@ def make_sharded_event_step(cfg: Config, mesh):
                 blk = blocked.sum(dtype=I32)
                 edge = edge & ~blocked
             dstg = jnp.where(edge, sf, 0).reshape(-1)
+            if multi:
+                # Every edge of a sender carries the sender's NEW bits.
+                ewords = jnp.broadcast_to(
+                    sw[:, None, :], (width, kwidth, sw.shape[1])
+                ).reshape(-1, sw.shape[1])
+                mail, cnt, dropped, xovf, nsup, mwords = _route_and_append(
+                    cfg, s, n_local, mail, cnt, dropped, xovf, dstg,
+                    jnp.broadcast_to(wslot2[:, None],
+                                     (width, kwidth)).reshape(-1),
+                    jnp.broadcast_to(off2[:, None],
+                                     (width, kwidth)).reshape(-1),
+                    edge.reshape(-1), ecap, words=ewords,
+                    mail_words=mwords)
+                return (flags, mail, cnt, dropped, xovf, nsup, blk,
+                        mwords)
             mail, cnt, dropped, xovf, nsup = _route_and_append(
                 cfg, s, n_local, mail, cnt, dropped, xovf, dstg,
                 jnp.broadcast_to(wslot2[:, None],
@@ -404,13 +502,13 @@ def make_sharded_event_step(cfg: Config, mesh):
         # crash clock only when reception crashes stamp it, partition
         # counter only when partitions exist -- the scenario-off carry is
         # the pre-scenario tuple exactly.
-        def pack(core, down, part):
+        def pack(core, down, part, mt=()):
             c = list(core)
             if track_down:
                 c.append(down)
             if track_part:
                 c.append(part)
-            return tuple(c)
+            return tuple(c) + tuple(mt)
 
         def unpack(c):
             core, i = c[:9], 9
@@ -418,23 +516,40 @@ def make_sharded_event_step(cfg: Config, mesh):
             if track_down:
                 down, i = c[i], i + 1
             if track_part:
-                part = c[i]
-            return core, down, part
+                part, i = c[i], i + 1
+            return core, down, part, c[i:]
 
         def body(j, carry):
             (flags, mail, cnt, sup, dm, dr, dc, dropped,
-             xovf), down, part = unpack(carry)
+             xovf), down, part, mt = unpack(carry)
+            mail_words = rumor_words = rrecv = delta_w = None
+            if multi:
+                mail_words, rumor_words, rrecv = mt
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
             packed = jax.lax.dynamic_slice(mail, (slot * cap + off0,),
                                            (ccap,))
-            flags, cdm, cdr, cdc, ids_s, toff_s, senders, down = \
-                event.drain_chunk_core(crash_p, b, n_local, flags,
-                                       packed, evalid, entry_pos,
-                                       ckey, sir=sir,
-                                       track_crashed=track_crashed,
-                                       down_since=down, win_tick=st.tick)
+            if multi:
+                wchunk = jax.lax.dynamic_slice(
+                    mail_words, (slot * cap + off0, 0),
+                    (ccap, mail_words.shape[1]))
+                (flags, cdm, cdr, cdc, ids_s, toff_s, senders, down,
+                 rumor_words, delta_w, drecv) = event.drain_chunk_core(
+                    crash_p, b, n_local, flags, packed, evalid,
+                    entry_pos, ckey, sir=sir,
+                    track_crashed=track_crashed, down_since=down,
+                    win_tick=st.tick, words=wchunk,
+                    rumor_words=rumor_words)
+                rrecv = rrecv + drecv
+            else:
+                flags, cdm, cdr, cdc, ids_s, toff_s, senders, down = \
+                    event.drain_chunk_core(crash_p, b, n_local, flags,
+                                           packed, evalid, entry_pos,
+                                           ckey, sir=sir,
+                                           track_crashed=track_crashed,
+                                           down_since=down,
+                                           win_tick=st.tick)
             dm, dr, dc = dm + cdm, dr + cdr, dc + cdc
             if scap:
                 # Sender compaction (see the single-device step's
@@ -455,6 +570,8 @@ def make_sharded_event_step(cfg: Config, mesh):
                     # width * kwidth: zero-loss per-pair receive buffer
                     # at this batch width (see the step-level comment).
                     def abody(jb, acarry):
+                        acarry = list(acarry)
+                        awords = acarry.pop() if multi else None
                         if track_part:
                             (aflags, amail, acnt, asup, adropped, axovf,
                              apart) = acarry
@@ -462,17 +579,31 @@ def make_sharded_event_step(cfg: Config, mesh):
                             (aflags, amail, acnt, asup, adropped,
                              axovf) = acarry
                             apart = None
-                        bids, btoff, bvalid = event.sender_batch(
-                            senders, srank, scnt, spacked, b, width, jb,
-                            lo=lo_of(jb))
-                        (aflags, amail, acnt, adropped, axovf, sa,
-                         ablk) = emit(aflags, amail, acnt, adropped,
-                                      axovf, bids, bvalid, w * b + btoff,
-                                      width, wire_cap(width * kwidth))
+                        if multi:
+                            bids, btoff, bvalid, bufw = event.sender_batch(
+                                senders, srank, scnt, spacked, b, width,
+                                jb, lo=lo_of(jb), sdelta=delta_w)
+                            (aflags, amail, acnt, adropped, axovf, sa,
+                             ablk, awords) = emit(
+                                aflags, amail, acnt, adropped, axovf,
+                                bids, bvalid, w * b + btoff, width,
+                                wire_cap(width * kwidth), sw=bufw,
+                                mwords=awords)
+                        else:
+                            bids, btoff, bvalid = event.sender_batch(
+                                senders, srank, scnt, spacked, b, width,
+                                jb, lo=lo_of(jb))
+                            (aflags, amail, acnt, adropped, axovf, sa,
+                             ablk) = emit(aflags, amail, acnt, adropped,
+                                          axovf, bids, bvalid,
+                                          w * b + btoff, width,
+                                          wire_cap(width * kwidth))
                         out = (aflags, amail, acnt, asup + sa[None, :],
                                adropped, axovf)
                         if track_part:
                             out = out + (apart + ablk,)
+                        if multi:
+                            out = out + (awords,)
                         return out
                     return abody
 
@@ -482,31 +613,47 @@ def make_sharded_event_step(cfg: Config, mesh):
                 acarry0 = (flags, mail, cnt, sup, dropped, xovf)
                 if track_part:
                     acarry0 = acarry0 + (part,)
+                if multi:
+                    acarry0 = acarry0 + (mail_words,)
                 out = event.run_narrow_tail(make_abody, acarry0, smax,
                                             scap)
                 (flags, mail, cnt, sup, dropped, xovf) = out[:6]
+                if multi:
+                    mail_words = out[-1]
                 if track_part:
                     part = out[6]
             else:
-                flags, mail, cnt, dropped, xovf, sa, blk = emit(
-                    flags, mail, cnt, dropped, xovf, ids_s, senders,
-                    w * b + toff_s, ccap, rcap)
+                if multi:
+                    (flags, mail, cnt, dropped, xovf, sa, blk,
+                     mail_words) = emit(
+                        flags, mail, cnt, dropped, xovf, ids_s, senders,
+                        w * b + toff_s, ccap, rcap, sw=delta_w,
+                        mwords=mail_words)
+                else:
+                    flags, mail, cnt, dropped, xovf, sa, blk = emit(
+                        flags, mail, cnt, dropped, xovf, ids_s, senders,
+                        w * b + toff_s, ccap, rcap)
                 sup = sup + sa[None, :]
                 if track_part:
                     part = part + blk
+            mt_out = (mail_words, rumor_words, rrecv) if multi else ()
             return pack((flags, mail, cnt, sup, dm, dr, dc, dropped,
-                         xovf), down, part)
+                         xovf), down, part, mt_out)
 
         z = jnp.zeros((), I32)
         # dm starts at this shard's deferred duplicate credits for the
         # draining window (banked by _route_and_append; appends during
         # this drain only target later windows), zeroed with mail_cnt.
+        # Under multi the dropped carry is seeded with the injection
+        # drops so they reach the per-window psum below.
+        mt0 = ((st.mail_words, st.rumor_words,
+                jnp.zeros_like(st.rumor_recv)) if multi else ())
         out = jax.lax.fori_loop(
             0, chunks, body,
             pack((st.flags, mail0, st.mail_cnt, st.sup_cnt,
-                  dm0, z, z, z, z), st.down_since, z))
+                  dm0, z, z, inj_drop, z), st.down_since, z, mt0))
         (flags, mail, cnt, sup, dm, dr, dc, ddrop,
-         dxovf), down, part = unpack(out)
+         dxovf), down, part, mt = unpack(out)
         cnt = cnt.at[0, slot].set(0)
         sup = sup.at[0, slot].set(0)
         dm, dr, dc, ddrop, dxovf = jax.lax.psum((dm, dr, dc, ddrop, dxovf),
@@ -519,6 +666,17 @@ def make_sharded_event_step(cfg: Config, mesh):
             total_crashed=st.total_crashed + dc,
             mail_dropped=st.mail_dropped + ddrop,
             exchange_overflow=st.exchange_overflow + dxovf)
+        if multi:
+            # Per-shard receive deltas fold into the replicated global
+            # per-rumor counters; done ticks stamp off the advanced tick
+            # (the same convention as the single-device step).
+            mail_words, rumor_words, rrecv = mt
+            rumor_recv = st.rumor_recv + jax.lax.psum(rrecv, AXIS)
+            rumor_done = event.stamp_rumor_done(cfg, rumor_recv,
+                                                st.rumor_done, st.tick)
+            st = st._replace(mail_words=mail_words,
+                             rumor_words=rumor_words,
+                             rumor_recv=rumor_recv, rumor_done=rumor_done)
         if track_down:
             st = st._replace(down_since=down)
         if scen.active:
@@ -542,6 +700,15 @@ def make_sharded_event_seed(cfg: Config, mesh):
     n_local = shard_size(cfg.n, mesh)
     b = event.batch_ticks(cfg)
     dw = event.ring_windows(cfg)
+
+    if cfg.multi_rumor:
+        # Multi-rumor sources come from the injection schedule inside the
+        # window step (owner-gated, OP_INJECT-keyed); the classic seed
+        # would double-infect rumor 0's source.
+        def seed_noop(st: EventState, base_key: jax.Array) -> EventState:
+            return st
+
+        return seed_noop
 
     def seed_shard(st: EventState, base_key: jax.Array) -> EventState:
         shard = jax.lax.axis_index(AXIS)
@@ -646,17 +813,44 @@ def make_sharded_event_heal(cfg: Config, mesh):
         off = jnp.broadcast_to((arrive % b)[:, None],
                                (n_local, k)).reshape(-1)
         rcap = min(exchange.epidemic_cap(n_local, k, s), n_local * k)
-        mail, cnt, dropped, xovf, _ = _route_and_append(
-            cfg, s, n_local, st.mail_ids, st.mail_cnt, jnp.zeros((), I32),
-            jnp.zeros((), I32), jnp.where(resend, friends, 0).reshape(-1),
-            wslot, off, resend.reshape(-1), rcap)
-        # Rejoin pull responses deliver to the puller's OWN row -- always
-        # shard-local, so they append directly.
-        ppay = jnp.broadcast_to(rows[:, None] * b,
-                                (n_local, k)).reshape(-1) + off
-        mail, cnt, dropped = _ring_append(
-            cfg, n_local, mail, cnt, dropped, ppay, wslot,
-            pull.reshape(-1))
+        if cfg.multi_rumor:
+            wc = st.rumor_words.shape[1]
+            # Resends carry the healer's FULL rumor set (cross-shard via
+            # the word-column route); rejoin pulls copy the friend's
+            # global word row -- one all_gather of the (n_local, W)
+            # uint32 leaf serves both the pull gather below and keeps
+            # the resend path local.
+            rw = jnp.broadcast_to(st.rumor_words[:, None, :],
+                                  (n_local, k, wc)).reshape(-1, wc)
+            mail, cnt, dropped, xovf, _, mailw = _route_and_append(
+                cfg, s, n_local, st.mail_ids, st.mail_cnt,
+                jnp.zeros((), I32), jnp.zeros((), I32),
+                jnp.where(resend, friends, 0).reshape(-1),
+                wslot, off, resend.reshape(-1), rcap, words=rw,
+                mail_words=st.mail_words)
+            ppay = jnp.broadcast_to(rows[:, None] * b,
+                                    (n_local, k)).reshape(-1) + off
+            global_words = jax.lax.all_gather(st.rumor_words, AXIS,
+                                              tiled=True)
+            fw = global_words[jnp.where(friends >= 0, friends,
+                                        0)].reshape(-1, wc)
+            mail, cnt, dropped, mailw = _ring_append(
+                cfg, n_local, mail, cnt, dropped, ppay, wslot,
+                pull.reshape(-1), words=fw, mail_words=mailw)
+            st = st._replace(mail_words=mailw)
+        else:
+            mail, cnt, dropped, xovf, _ = _route_and_append(
+                cfg, s, n_local, st.mail_ids, st.mail_cnt,
+                jnp.zeros((), I32), jnp.zeros((), I32),
+                jnp.where(resend, friends, 0).reshape(-1),
+                wslot, off, resend.reshape(-1), rcap)
+            # Rejoin pull responses deliver to the puller's OWN row --
+            # always shard-local, so they append directly.
+            ppay = jnp.broadcast_to(rows[:, None] * b,
+                                    (n_local, k)).reshape(-1) + off
+            mail, cnt, dropped = _ring_append(
+                cfg, n_local, mail, cnt, dropped, ppay, wslot,
+                pull.reshape(-1))
         rep, blk, dropped, xovf = jax.lax.psum(
             (rep, jnp.asarray(blk, I32), dropped, xovf), AXIS)
         return st._replace(
@@ -707,6 +901,10 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
     # Heal-on runs drop the early-death exit (see event.make_run_to_
     # coverage_fn).
     check_in_flight = not cfg.overlay_heal_resolved
+    multi = cfg.multi_rumor
+    rumors = cfg.rumors
+    stream = cfg.traffic == "stream"
+    last_inj = cfg.last_inject_tick
 
     def cond_live(s, target_count, until):
         # The in-flight term (psum of each shard's ring-occupied
@@ -717,10 +915,23 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
         # (event.make_run_to_coverage_fn).  Indicator, not count:
         # a cross-shard sum of entry counts could wrap int32 near
         # ring occupancy.
-        live = ((s.total_received < target_count)
+        if multi:
+            # Every rumor must hit the target (rumor_recv is
+            # replicated; lanes >= R are padding, always 0).
+            recv = jnp.min(s.rumor_recv[:rumors])
+        else:
+            recv = s.total_received
+        live = ((recv < target_count)
                 & (s.tick < max_steps) & (s.tick < until))
         if check_in_flight:
-            live = live & (jax.lax.psum(event.in_flight(s), AXIS) > 0)
+            alive = jax.lax.psum(event.in_flight(s), AXIS) > 0
+            if multi:
+                # An empty ring is not death while the injection
+                # schedule still has rumors to start -- including tick 0
+                # of a oneshot run (last_inj = 0): seeding happens INSIDE
+                # the first window step, not before the loop.
+                alive = alive | (s.tick <= last_inj)
+            live = live & alive
         return live
 
     def advance(s, base_key):
@@ -747,7 +958,8 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
                     s = advance(s, base_key)
                     row = telem.gossip_probe(
                         s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
-                        pmax=lambda x: jax.lax.pmax(x, AXIS))
+                        pmax=lambda x: jax.lax.pmax(x, AXIS),
+                        rumors=rumors if multi else 0)
                     return s, telem.record(h, row)
 
                 return jax.lax.while_loop(cond, body, (st, hist))

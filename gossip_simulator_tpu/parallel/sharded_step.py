@@ -48,6 +48,11 @@ def sim_state_specs(cfg: Config) -> SimState:
         down_since=P(AXIS) if cfg.faults_enabled else P(),
         scen_crashed=P(), scen_recovered=P(), part_dropped=P(),
         heal_repaired=P(),
+        # Multi-rumor rides the event engine only on meshes (config
+        # rejects ring+multi at S > 1), so these stay the 1-element
+        # replicated placeholders.
+        pending_rumors=P(), rumor_words=P(), rumor_recv=P(),
+        rumor_done=P(),
     )
 
 

@@ -138,6 +138,43 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
         # geometry into a shape-mismatched restore (advisor r5).
         tree["friends"] = np.full((n, 1), -1, np.int32)
         tree["friend_cnt"] = np.zeros((n,), np.int32)
+    # --- multi-rumor traffic leaves (models/state.py rumor axis) ----------
+    ckpt_multi = ("rumor_words" in tree
+                  and tuple(np.asarray(tree["rumor_words"]).shape) != (1, 1))
+    if cfg.multi_rumor and not ckpt_multi:
+        raise ValueError(
+            "checkpoint was written by a single-rumor run but this run "
+            f"has -rumors {cfg.rumors} -traffic {cfg.traffic}; the "
+            "snapshot does not record which rumors were in flight -- "
+            "restore it with -rumors 1 -traffic oneshot, or restart the "
+            "multi-rumor run from scratch")
+    if ckpt_multi and not cfg.multi_rumor:
+        raise ValueError(
+            "checkpoint carries multi-rumor state "
+            f"({int(np.asarray(tree['rumor_recv']).shape[0])} rumor "
+            "lanes) but this run is single-rumor; restore with the "
+            "snapshot's -rumors / -traffic flags")
+    if cfg.multi_rumor:
+        ckpt_w = int(np.asarray(tree["rumor_words"]).shape[1])
+        if ckpt_w != cfg.rumor_word_count:
+            raise ValueError(
+                f"checkpoint rumor bitmask is {ckpt_w} word(s) wide but "
+                f"-rumors {cfg.rumors} needs {cfg.rumor_word_count} "
+                "(= ceil(R/32)); restore with the snapshot's -rumors")
+    else:
+        # Legacy (pre-rumor-axis) snapshot into a single-rumor run:
+        # backfill the 1-element placeholders (nothing was in flight
+        # on an axis that did not exist).
+        u1 = np.zeros((1, 1), np.uint32)
+        fills = {"rumor_words": u1, "rumor_recv": np.zeros((1,), np.int32),
+                 "rumor_done": np.full((1,), -1, np.int32)}
+        if ckpt_engine == "event":
+            fills["mail_words"] = u1
+        else:
+            fills["pending_rumors"] = np.zeros((1, 1, 1), np.int32)
+        for k, v in fills.items():
+            if k not in tree:
+                tree[k] = v
     if ckpt_engine == "event":
         n_local = n // n_shards
         dw = event.ring_windows(cfg)
@@ -183,32 +220,43 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                     f"checkpoint mail_ids length {mail_len} contradicts "
                     f"its stored geometry (cap={ocap}, chunk={ochunk}, "
                     f"{s_ckpt} shard(s))")
+            mw = (np.asarray(tree["mail_words"])
+                  if cfg.multi_rumor else None)
             if s_ckpt != n_shards:
                 # Shard-count resharding (round 5): decode every in-flight
                 # entry to its GLOBAL destination, re-bucket under the new
-                # shard count, and re-pack in the new geometry.
-                mail2, cnt2, sup2, lost = reshard_mail_rings(
+                # shard count, and re-pack in the new geometry.  The rumor
+                # payload words ride the identical re-bucketing.
+                mail2, cnt2, sup2, lost, mw2 = reshard_mail_rings(
                     np.asarray(tree["mail_ids"]),
                     np.asarray(tree["mail_cnt"]),
                     np.asarray(tree["sup_cnt"]), cfg, s_ckpt, n_shards,
-                    dw, ocap, otail)
+                    dw, ocap, otail, words=mw)
                 tree["mail_ids"], tree["mail_cnt"] = mail2, cnt2
                 tree["sup_cnt"] = sup2
+                if mw2 is not None:
+                    tree["mail_words"] = mw2
                 tree["mail_dropped"] = np.asarray(
                     tree["mail_dropped"]) + np.int32(lost)
             elif per_old != per_new or ocap != ncap:
                 old = np.asarray(tree["mail_ids"])
                 cnt = np.asarray(tree["mail_cnt"])
-                mails, cnts, lost = [], [], 0
+                mails, cnts, words, lost = [], [], [], 0
                 for sh in range(n_shards):
-                    m, c, sl = repack_mail_ring(
+                    m, c, sl, w2 = repack_mail_ring(
                         old[sh * per_old:(sh + 1) * per_old], cnt[sh],
-                        ocap, otail, ncap, ntail, dw)
+                        ocap, otail, ncap, ntail, dw,
+                        words=(mw[sh * per_old:(sh + 1) * per_old]
+                               if mw is not None else None))
                     mails.append(m)
                     cnts.append(c)
+                    if w2 is not None:
+                        words.append(w2)
                     lost += sl
                 tree["mail_ids"] = np.concatenate(mails)
                 tree["mail_cnt"] = np.stack(cnts)
+                if words:
+                    tree["mail_words"] = np.concatenate(words)
                 tree["mail_dropped"] = np.asarray(
                     tree["mail_dropped"]) + np.int32(lost)
     else:
@@ -373,7 +421,7 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
 
 def reshard_mail_rings(mail: np.ndarray, cnt: np.ndarray, sup: np.ndarray,
                        cfg, s_old: int, s_new: int, dw: int, ocap: int,
-                       otail: int):
+                       otail: int, words: Optional[np.ndarray] = None):
     """Re-bucket S_old concatenated per-shard mail rings onto S_new shards
     (models/event.py packing: entry = dst_local * B + off, SIR triggers at
     trigger_base(n_local) + id * B + off -- both depend on the PER-SHARD
@@ -384,7 +432,9 @@ def reshard_mail_rings(mail: np.ndarray, cnt: np.ndarray, sup: np.ndarray,
     batch routing already performs.  Deferred duplicate credits (sup_cnt)
     are only ever summed across shards, so the per-slot totals land on
     shard 0.  Entries past the new slot capacity are dropped (counted).
-    Returns (mail, cnt, sup, lost) in the new geometry."""
+    `words` (multi-rumor payload word rings, same concatenated layout)
+    rides the identical re-bucketing.  Returns (mail, cnt, sup, lost,
+    words) in the new geometry (words None when not given)."""
     from gossip_simulator_tpu.models import event
 
     n = cfg.n
@@ -397,55 +447,69 @@ def reshard_mail_rings(mail: np.ndarray, cnt: np.ndarray, sup: np.ndarray,
     tbo, tbn = event.trigger_base(nlo, b), event.trigger_base(nln, b)
     new_mail = np.zeros((s_new * per_new,), np.int32)
     new_cnt = np.zeros((s_new, dw), np.int32)
+    new_words = (np.zeros((s_new * per_new, words.shape[1]), words.dtype)
+                 if words is not None else None)
     lost = 0
     for slot in range(dw):
         segs = []
         for sh in range(s_old):
             c = int(cnt[sh, slot])
-            seg = mail[sh * per_old + slot * ocap:
-                       sh * per_old + slot * ocap + c].astype(np.int64)
+            at0 = sh * per_old + slot * ocap
+            seg = mail[at0:at0 + c].astype(np.int64)
             trig = seg >= tbo if sir else np.zeros(seg.shape, bool)
             base = np.where(trig, seg - tbo, seg)
             gid = base // b + sh * nlo
             off = base % b
-            segs.append((gid, off, trig))
+            segs.append((gid, off, trig, at0 + np.arange(c)))
         gid = np.concatenate([s[0] for s in segs])
         off = np.concatenate([s[1] for s in segs])
         trig = np.concatenate([s[2] for s in segs])
+        pos = np.concatenate([s[3] for s in segs])
         nsh = gid // nln
         ndl = gid % nln
         ent = np.where(trig, tbn + ndl * b + off, ndl * b + off)
         for t in range(s_new):
-            e = ent[nsh == t].astype(np.int32)
+            sel = nsh == t
+            e = ent[sel].astype(np.int32)
             take = min(len(e), ncap)
             lost += len(e) - take
             at = t * per_new + slot * ncap
             new_mail[at:at + take] = e[:take]
+            if new_words is not None:
+                new_words[at:at + take] = words[pos[sel][:take].astype(
+                    np.int64)]
             new_cnt[t, slot] = take
     new_sup = np.zeros((s_new, dw), np.int32)
     new_sup[0] = sup.astype(np.int64).sum(axis=0)
-    return new_mail, new_cnt, new_sup, lost
+    return new_mail, new_cnt, new_sup, lost, new_words
 
 
 def repack_mail_ring(mail: np.ndarray, cnt: np.ndarray, ocap: int,
-                     otail: int, ncap: int, ntail: int,
-                     dw: int) -> tuple[np.ndarray, np.ndarray, int]:
+                     otail: int, ncap: int, ntail: int, dw: int,
+                     words: Optional[np.ndarray] = None):
     """Repack one packed mail ring (models/event.py layout: slot s occupies
     [s*cap, (s+1)*cap), plus a `tail` slack region) from slot geometry
     (ocap, otail) to (ncap, ntail) -- snapshots written under different
     -event-* flags or an auto sizing that changed.  Entries beyond the new
     capacity are dropped (returned in `lost`, counted like any overflow).
 
-    `cnt` is the per-slot entry count, shape (dw,).  Returns
-    (new_mail, clamped_cnt, lost)."""
+    `cnt` is the per-slot entry count, shape (dw,); `words` (multi-rumor
+    payload word ring, same layout) moves with its entries.  Returns
+    (new_mail, clamped_cnt, lost, new_words) -- words None when not
+    given."""
     if mail.shape[0] != dw * ocap + otail:
         raise ValueError(
             f"mail ring length {mail.shape[0]} contradicts its geometry "
             f"(cap={ocap}, tail={otail}, dw={dw})")
     new = np.zeros((dw * ncap + ntail,), mail.dtype)
+    new_words = (np.zeros((dw * ncap + ntail, words.shape[1]), words.dtype)
+                 if words is not None else None)
     lost = 0
     for s in range(dw):
         take = min(int(cnt[s]), ncap)
         lost += int(cnt[s]) - take
         new[s * ncap:s * ncap + take] = mail[s * ocap:s * ocap + take]
-    return new, np.minimum(cnt, ncap), lost
+        if new_words is not None:
+            new_words[s * ncap:s * ncap + take] = \
+                words[s * ocap:s * ocap + take]
+    return new, np.minimum(cnt, ncap), lost, new_words

@@ -53,10 +53,20 @@ class Stats:
     # threaded here so printer.done() reports the true nonconvergence cause
     # on both the windowed and the fast path (reason parity).
     exhausted: bool = False
+    # --- multi-rumor traffic (-rumors / -traffic) ------------------------
+    rumors: int = 1  # concurrent rumor count R (1 = classic single-rumor)
+    rumor_min_recv: int = -1  # min over rumors of per-rumor infected count
+    rumors_done: int = 0  # rumors that have reached the coverage target
 
     @property
     def coverage(self) -> float:
-        return self.total_received / self.n if self.n else 0.0
+        if not self.n:
+            return 0.0
+        if self.rumors > 1 or self.rumor_min_recv >= 0:
+            # Multi-rumor convergence is the WORST rumor's coverage: the
+            # run is done when every rumor has reached the target.
+            return max(self.rumor_min_recv, 0) / self.n
+        return self.total_received / self.n
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)

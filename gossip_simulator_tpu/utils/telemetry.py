@@ -40,7 +40,7 @@ import numpy as np
 # replayed stdout/JSONL surface is unchanged.
 GOSSIP_COLS = ("tick", "received", "msg_hi", "msg_lo", "crashed", "removed",
                "mail_high", "dropped", "overflow", "scen_crashed",
-               "recovered", "repaired", "part_dropped")
+               "recovered", "repaired", "part_dropped", "rumors_done")
 OVERLAY_COLS = ("clock", "makeups", "breakups", "dropped")
 
 
@@ -70,12 +70,15 @@ def record(hist: History, row) -> History:
     return History(idx=hist.idx + 1, cols=hist.cols.at[i].set(vals))
 
 
-def gossip_probe(st, sir: bool, psum=None, pmax=None):
+def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0):
     """One GOSSIP_COLS row from either epidemic engine's state (duck-typed
     like models/state.in_flight: EventState has the mail ring, SimState the
     pending ring).  `psum`/`pmax` are the sharded engines' cross-shard
     reductions for the per-shard quantities (removed flags, ring occupancy);
-    the totals are already psum-replicated by the step functions."""
+    the totals are already psum-replicated by the step functions.  `rumors`
+    (static R; 0 = single-rumor) adds the count of rumors that have hit the
+    coverage target -- rumor_done is replicated on every engine, so no
+    reduction applies."""
     import jax
     import jax.numpy as jnp
 
@@ -98,10 +101,11 @@ def gossip_probe(st, sir: bool, psum=None, pmax=None):
     if pmax is not None:
         high = pmax(high)
     msg = jax.lax.bitcast_convert_type(st.total_message, I32)
+    rdone = (st.rumor_done[:rumors] >= 0).sum(dtype=I32) if rumors else z
     return [st.tick, st.total_received, msg[0], msg[1], st.total_crashed,
             removed, high, dropped, st.exchange_overflow,
             st.scen_crashed, st.scen_recovered, st.heal_repaired,
-            st.part_dropped]
+            st.part_dropped, rdone]
 
 
 def overlay_probe(st):
@@ -317,6 +321,9 @@ class TelemetryReport:
                     per["scen_recovered"] = cols[:count, 10].tolist()
                     per["heal_repaired"] = cols[:count, 11].tolist()
                     per["part_dropped"] = cols[:count, 12].tolist()
+                if cols.shape[1] > 13 and bool(cols[:count, 13].any()):
+                    # Multi-rumor column only when rumors completed.
+                    per["rumors_done"] = cols[:count, 13].tolist()
                 out["per_window"] = per
                 out["deltas"] = {
                     "received": np.diff(cols[:count, 1],

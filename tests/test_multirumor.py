@@ -1,0 +1,383 @@
+"""Concurrent multi-rumor traffic (-rumors / -traffic, ISSUE 8).
+
+Four surfaces:
+* ``-rumors 1 -traffic oneshot`` (the default) A/B pins: trajectory
+  fingerprints hard-coded from the PRE-multirumor build (captured at
+  commit 985cea5 on the tier-1 CPU host), so the classic single-rumor
+  path is pinned bit-identical to HEAD on all four engine combos -- the
+  same discipline as test_scenario's PRE_SCENARIO_FP.
+* Multi-rumor semantics: R rumors through the ONE shared mailbox/drain
+  machinery (per-rumor coverage, done-tick stamping, streaming
+  injection staircase, fast-path/windowed parity, serving metrics in
+  the terminal JSONL record).
+* Checkpointing: rumor-axis round trips, legacy single-rumor snapshot
+  coercion (backfill into single-rumor runs, named rejection into
+  multi runs), word-width mismatch rejection, and the S=1<->S=8
+  mid-stream reshard.
+* Scenario interop: R=16 under the PR-4 churn+partition timeline with
+  -overlay-heal on still reaches the target for every rumor injected
+  before the partition.
+"""
+
+import hashlib
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.utils import checkpoint
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+# Same rationale as tests/test_checkpoint.py: the legacy shard_map line's
+# CPU collective rendezvous deadlocks when two different sharded
+# executables interleave in one process, which the reshard test does.
+legacy_shard_map_deadlock = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="legacy shard_map: CPU collective rendezvous deadlocks when two "
+           "sharded executables interleave in one process")
+
+BASE = dict(graph="kout", fanout=6, seed=3, crashrate=0.01,
+            coverage_target=0.95, progress=False)
+
+
+def _fingerprint(cfg, max_windows=400):
+    """Per-window (round, received, message, crashed, removed) trajectory
+    hash via the windowed driver loop -- the same capture the pre-PR
+    constants below were recorded with (test_scenario.py convention)."""
+    from gossip_simulator_tpu.backends import make_stepper
+
+    s = make_stepper(cfg)
+    s.init()
+    while not s.overlay_window()[2]:
+        pass
+    s.seed()
+    rows = []
+    for _ in range(max_windows):
+        st = s.gossip_window()
+        rows.append((st.round, st.total_received, st.total_message,
+                     st.total_crashed, st.total_removed))
+        if st.coverage >= cfg.coverage_target or s.exhausted:
+            break
+    h = hashlib.sha256(json.dumps(rows).encode()).hexdigest()[:16]
+    return {"windows": len(rows), "final": list(rows[-1]), "hash": h}
+
+
+def _stepper(cfg):
+    from gossip_simulator_tpu.backends import make_stepper
+
+    s = make_stepper(cfg)
+    s.init()
+    while not s.overlay_window()[2]:
+        pass
+    s.seed()
+    return s
+
+
+def _rumor_arrays(stepper, r):
+    recv = np.asarray(jax.device_get(stepper.state.rumor_recv))[:r]
+    done = np.asarray(jax.device_get(stepper.state.rumor_done))[:r]
+    return recv, done
+
+
+def _run_to_target_windowed(stepper, cfg, max_windows=400):
+    for _ in range(max_windows):
+        st = stepper.gossip_window()
+        if st.coverage >= cfg.coverage_target or stepper.exhausted:
+            break
+    return st
+
+
+# --------------------------------------------------------------------------
+# Default-path bit-identity pins (captured at the pre-multirumor HEAD,
+# commit 985cea5, on the tier-1 CPU host)
+# --------------------------------------------------------------------------
+
+PRE_MULTIRUMOR_FP = {
+    "jax_event": {"windows": 9, "final": [90, 2928, 12791, 125, 0],
+                  "hash": "477b07759900a563"},
+    "jax_ring": {"windows": 9, "final": [90, 2940, 13034, 126, 0],
+                 "hash": "33a08f76cf24827b"},
+    "sharded_event": {"windows": 10, "final": [100, 3890, 18320, 204, 0],
+                      "hash": "b8c00f159feac434"},
+    "sharded_ring": {"windows": 11, "final": [110, 3910, 17988, 191, 0],
+                     "hash": "a7f0a9290df481e5"},
+}
+
+FP_COMBOS = {
+    "jax_event": dict(n=3000, backend="jax", engine="event"),
+    "jax_ring": dict(n=3000, backend="jax", engine="ring"),
+    "sharded_event": dict(n=4000, backend="sharded", engine="event"),
+    "sharded_ring": dict(n=4000, backend="sharded", engine="ring"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(FP_COMBOS))
+def test_default_single_rumor_bit_identical(name):
+    """-rumors 1 -traffic oneshot (the default, implicitly) must leave all
+    four engine combos bit-identical to the pre-multirumor build: every
+    rumor gate is a Python-static branch, so the traced program -- and
+    therefore the trajectory -- is unchanged."""
+    cfg = Config(**BASE, **FP_COMBOS[name]).validate()
+    assert not cfg.multi_rumor
+    assert _fingerprint(cfg) == PRE_MULTIRUMOR_FP[name]
+
+
+# --------------------------------------------------------------------------
+# Multi-rumor semantics
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    dict(n=2000, backend="jax", engine="event"),
+    dict(n=2000, backend="jax", engine="ring"),
+    dict(n=4000, backend="sharded", engine="event"),
+], ids=["jax_event", "jax_ring", "sharded_event"])
+def test_oneshot_r8_every_rumor_reaches_target(kw):
+    """R=8 rumors from 8 random sources through the ONE shared delivery
+    machinery: each rumor's per-lane count reaches the target and its
+    done tick is stamped; Stats reports min-coverage semantics."""
+    cfg = Config(**{**BASE, "crashrate": 0.0}, rumors=8, **kw).validate()
+    s = _stepper(cfg)
+    stats = _run_to_target_windowed(s, cfg)
+    recv, done = _rumor_arrays(s, 8)
+    target = int(np.ceil(0.95 * cfg.n))
+    assert (recv >= target).all(), recv
+    assert (done >= 0).all(), done
+    assert stats.rumors == 8 and stats.rumors_done == 8
+    assert stats.rumor_min_recv == int(recv.min())
+    assert stats.coverage == recv.min() / cfg.n
+
+
+@pytest.mark.parametrize("backend,n", [("jax", 2000), ("sharded", 4000)],
+                         ids=["jax", "sharded"])
+def test_oneshot_fast_path_injects_at_tick_zero(backend, n):
+    """Regression: oneshot multi-rumor seeding happens INSIDE the first
+    window step (seed() is a no-op under the rumor axis), so the bounded
+    device loop's in-flight liveness term must not read the empty tick-0
+    ring as a dead wave -- it exited with zero windows before the
+    last_inject_tick keep-alive covered oneshot (last_inj = 0)."""
+    cfg = Config(**{**BASE, "crashrate": 0.0}, n=n, backend=backend,
+                 engine="event", rumors=8).validate()
+    s = _stepper(cfg)
+    stats = s.run_to_target()
+    recv, done = _rumor_arrays(s, 8)
+    assert stats.round > 0
+    assert (recv >= int(np.ceil(0.95 * n))).all(), recv
+    assert (done >= 0).all() and stats.rumors_done == 8
+
+
+@pytest.mark.parametrize("backend,n", [("jax", 2000), ("sharded", 4000)],
+                         ids=["jax", "sharded"])
+def test_stream_staircase_and_fastpath_parity(backend, n):
+    """-traffic stream at 100 rumors/s: later rumors finish later (the
+    injection staircase), and the bounded device-side fast path lands on
+    the SAME per-rumor done ticks as the windowed loop."""
+    kw = dict(**{**BASE, "crashrate": 0.0}, n=n, backend=backend,
+              engine="event", rumors=16, traffic="stream", stream_rate=100)
+    cfg = Config(**kw).validate()
+    s = _stepper(cfg)
+    _run_to_target_windowed(s, cfg)
+    recv, done = _rumor_arrays(s, 16)
+    target = int(np.ceil(0.95 * n))
+    assert (recv >= target).all() and (done >= 0).all()
+    # Rumor r injects at r*10ms; done ticks follow the schedule upward.
+    assert done[-1] > done[0]
+    assert all(done[i] <= done[i + 1] + 20 for i in range(15)), done
+
+    s2 = _stepper(cfg)
+    stats2 = s2.run_to_target()
+    _, done2 = _rumor_arrays(s2, 16)
+    assert done2.tolist() == done.tolist()
+    assert stats2.rumors_done == 16
+
+
+def test_stream_result_record_reports_serving_metrics(tmp_path):
+    """The terminal JSONL `result` record of a stream run carries the
+    steady-state serving metrics (rumors/s to target, deliveries/s,
+    per-rumor latency histogram) -- the CI smoke asserts the same."""
+    log = tmp_path / "run.jsonl"
+    cfg = Config(**{**BASE, "crashrate": 0.0}, n=2000, backend="jax",
+                 engine="event", rumors=16, traffic="stream",
+                 stream_rate=100, log_jsonl=str(log)).validate()
+    res = run_simulation(cfg)
+    assert res.converged
+    recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    result = [r for r in recs if r.get("event") == "result"][-1]
+    assert result["traffic"] == "stream"
+    assert result["rumors"] == 16 and result["rumors_done"] == 16
+    assert result["rumors_per_sec"] > 0
+    assert result["deliveries_per_sec"] > 0
+    lat = result["rumor_latency_ms"]
+    assert 0 <= lat["min"] <= lat["p50"] <= lat["p90"] <= lat["max"]
+    assert sum(result["rumor_latency_hist"]["counts"]) == 16
+    # The device-resident telemetry history carries the rumors_done
+    # column; the telemetry record exposes it per window.
+    telem = [r for r in recs if r.get("event") == "telemetry"][-1]
+    rd = telem["per_window"]["rumors_done"]
+    assert rd[-1] == 16 and rd == sorted(rd)
+
+
+def test_multi_rejects_dup_suppress_and_ring_mesh():
+    with pytest.raises(ValueError, match="dup"):
+        Config(n=2000, rumors=4, dup_suppress="on").validate()
+    with pytest.raises(ValueError, match="rumors"):
+        Config(n=4000, backend="sharded", engine="ring",
+               rumors=4).validate()
+    with pytest.raises(ValueError, match="stream"):
+        Config(n=2000, engine="ring", traffic="stream").validate()
+
+
+# --------------------------------------------------------------------------
+# Checkpointing the rumor axis
+# --------------------------------------------------------------------------
+
+def test_multi_checkpoint_roundtrip_mid_stream(tmp_path):
+    """Snapshot a stream run mid-injection, restore, and the per-window
+    Stats match the uninterrupted run exactly (the injection schedule is
+    (seed, tick)-keyed, so it continues where it left off)."""
+    cfg = Config(**{**BASE, "crashrate": 0.0}, n=2000, backend="jax",
+                 engine="event", rumors=16, traffic="stream",
+                 stream_rate=100).validate()
+    s = _stepper(cfg)
+    for _ in range(12):  # tick 120: some rumors done, last injects at 150
+        s.gossip_window()
+    mid = s.stats()
+    assert 0 < mid.rumors_done < 16  # genuinely mid-stream
+    path = checkpoint.save(str(tmp_path), 12, s.state_pytree(), mid)
+    reference = [s.gossip_window() for _ in range(8)]
+
+    s2 = _stepper(cfg)
+    tree, meta = checkpoint.load(path)
+    assert meta["rumors"] == 16
+    s2.load_state_pytree(tree)
+    assert s2.stats() == mid
+    for want in reference:
+        assert s2.gossip_window() == want
+
+
+def test_legacy_snapshot_backfills_into_single_rumor_run(tmp_path):
+    """A pre-rumor-axis snapshot (no rumor leaves at all) restores into a
+    single-rumor run: the placeholders are backfilled (nothing was in
+    flight on an axis that did not exist) and the run converges."""
+    cfg = Config(**{**BASE, "crashrate": 0.0}, n=2000,
+                 backend="jax", engine="event").validate()
+    s = _stepper(cfg)
+    s.gossip_window()
+    tree = s.state_pytree()
+    for k in ("mail_words", "rumor_words", "rumor_recv", "rumor_done"):
+        tree.pop(k)
+    path = checkpoint.save(str(tmp_path), 1, tree, s.stats())
+
+    s2 = _stepper(cfg)
+    loaded, _ = checkpoint.load(path)
+    s2.load_state_pytree(loaded)
+    st = _run_to_target_windowed(s2, cfg)
+    assert st.coverage >= 0.95
+
+
+def test_legacy_snapshot_into_multi_run_rejected():
+    """The same legacy snapshot cannot resume a multi-rumor run: which
+    rumors were in flight is unrecoverable -- named rejection."""
+    cfg1 = Config(**{**BASE, "crashrate": 0.0}, n=2000,
+                  backend="jax", engine="event").validate()
+    s = _stepper(cfg1)
+    s.gossip_window()
+    tree = s.state_pytree()
+    for k in ("mail_words", "rumor_words", "rumor_recv", "rumor_done"):
+        tree.pop(k)
+    cfg8 = cfg1.replace(rumors=8).validate()
+    s2 = _stepper(cfg8)
+    with pytest.raises(ValueError, match="-rumors"):
+        s2.load_state_pytree(tree)
+
+
+def test_multi_snapshot_into_single_rumor_run_rejected():
+    cfg8 = Config(**{**BASE, "crashrate": 0.0}, n=2000, backend="jax",
+                  engine="event", rumors=8).validate()
+    s = _stepper(cfg8)
+    s.gossip_window()
+    tree = s.state_pytree()
+    s1 = _stepper(Config(**{**BASE, "crashrate": 0.0}, n=2000,
+                         backend="jax", engine="event").validate())
+    with pytest.raises(ValueError, match="rumors"):
+        s1.load_state_pytree(tree)
+
+
+def test_rumor_word_width_mismatch_rejected():
+    """An R=40 snapshot (2 bitmask words) cannot restore under -rumors 16
+    (1 word): the lanes would alias."""
+    cfg40 = Config(**{**BASE, "crashrate": 0.0}, n=1000, backend="jax",
+                   engine="event", rumors=40).validate()
+    s = _stepper(cfg40)
+    s.gossip_window()
+    tree = s.state_pytree()
+    s16 = _stepper(cfg40.replace(rumors=16).validate())
+    with pytest.raises(ValueError, match="word"):
+        s16.load_state_pytree(tree)
+
+
+@legacy_shard_map_deadlock
+def test_multi_reshard_1_to_8_and_back_mid_stream(tmp_path):
+    """S=1 -> S=8 -> S=1 mid-stream: in-flight rumor-carrying mail entries
+    are decoded to global destinations and re-bucketed WITH their payload
+    words; the resumed runs converge with every rumor delivered (the
+    injection schedule is shard-count invariant, so rumors not yet
+    injected at snapshot time still appear)."""
+    kw = dict(**{**BASE, "crashrate": 0.0}, n=4000, engine="event",
+              rumors=16, traffic="stream", stream_rate=100)
+    cfg1 = Config(backend="jax", **kw).validate()
+    cfg8 = Config(backend="sharded", **kw).validate()
+
+    s = _stepper(cfg1)
+    for _ in range(12):  # mid-stream: in-flight mail AND pending injections
+        s.gossip_window()
+    mid = s.stats()
+    assert 0 < mid.rumors_done < 16
+    path = checkpoint.save(str(tmp_path), 12, s.state_pytree(), mid)
+
+    tree, _ = checkpoint.load(path)
+    s8 = _stepper(cfg8)
+    s8.load_state_pytree(tree)
+    s8.gossip_window()
+    s8.gossip_window()
+    path2 = checkpoint.save(str(tmp_path), 14, s8.state_pytree(),
+                            s8.stats())
+
+    tree2, _ = checkpoint.load(path2)
+    s1b = _stepper(cfg1)
+    s1b.load_state_pytree(tree2)
+    st = _run_to_target_windowed(s1b, cfg1)
+    recv, done = _rumor_arrays(s1b, 16)
+    assert st.coverage >= 0.95
+    assert (recv >= int(np.ceil(0.95 * 4000))).all(), recv
+    assert (done >= 0).all(), done
+
+
+# --------------------------------------------------------------------------
+# Scenario interop: churn + partition + healing under multi-rumor load
+# --------------------------------------------------------------------------
+
+# The PR-4 acceptance timeline (bench.py CHURN_SCENARIO, verbatim).
+CHURN = ('{"groups": 2, "downtime": 60, "events": ['
+         '{"type": "churn", "start": 0, "end": 150, "rate": 2.0},'
+         '{"type": "crash", "at": 30, "frac": 0.3, "group": 1},'
+         '{"type": "partition", "start": 20, "end": 60}]}')
+
+
+def test_churn_partition_heal_r16_all_pre_partition_rumors_covered():
+    """R=16 under the churn+crash+partition timeline with -overlay-heal
+    on: every rumor injected before the partition window (oneshot -> all
+    16, at tick 0 < 20) reaches the 99% target -- churned nodes
+    rejoin-pull their friends' FULL rumor sets."""
+    cfg = Config(n=3000, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                 coverage_target=0.99, max_rounds=600, scenario=CHURN,
+                 overlay_heal="on", backend="jax", engine="event",
+                 rumors=16, progress=False).validate()
+    res = run_simulation(cfg, printer=ProgressPrinter(enabled=False))
+    assert res.converged, res.stats
+    assert res.stats.rumors_done == 16
+    assert res.stats.rumor_min_recv >= int(np.ceil(0.99 * 3000))
+    assert res.stats.heal_repaired > 0
+    assert res.stats.scen_crashed >= 0.2 * 3000
