@@ -549,6 +549,32 @@ def capture_multirumor_50m(detail: dict, seed: int) -> None:
         detail[name] = pool_retry(_bench_backend, cfg, name=name)
 
 
+def capture_deliver_kernel_twins(detail: dict, seed: int) -> None:
+    """-deliver-kernel A/B twins at scale (ISSUE 9): the 50M suite shape,
+    its R=16 multi-rumor sibling, and the 100M north-star shape, each run
+    with the fused pallas delivery vs the XLA sort/rank/scatter chain it
+    replaces at the SAME n/graph/seed.  Interpret-mode CI already pins
+    bit-identical trajectories (tests/test_pallas_deliver.py), so these
+    rows exist to record the measured wall-clock delta on real hardware;
+    an unreachable axon pool leaves dated skip records that re-queue the
+    measurement for the next TPU pass."""
+    base = Config(n=50_000_000, fanout=6, graph="kout", backend="jax",
+                  seed=seed, crashrate=0.0, coverage_target=0.95,
+                  max_rounds=3000, progress=False).validate()
+    star = Config(n=100_000_000, fanout=6, graph="kout", backend="jax",
+                  seed=seed, crashrate=0.0, coverage_target=0.99,
+                  max_rounds=3000, pallas=True, progress=False).validate()
+    for name, cfg in (("deliver_50m", base),
+                      ("deliver_50m_r16", base.replace(rumors=16)),
+                      ("deliver_100m_99pct", star)):
+        for kern in ("xla", "pallas"):
+            row = pool_retry(
+                _bench_backend,
+                cfg.replace(deliver_kernel=kern).validate(),
+                name=f"{name}_{kern}")
+            detail[f"{name}_{kern}"] = row
+
+
 def capture_100m(detail: dict, seed: int, headline_n: int) -> None:
     """The 100M single-chip rows (BASELINE.md north-star scale), captured in
     the driver-recorded bench output rather than only in the README.
@@ -599,8 +625,10 @@ def _pallas_validation() -> dict:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         result = mod.run_checks()
-        with open(os.path.join(here, "PALLAS_VALIDATION.json"), "w") as fh:
-            json.dump(result, fh, indent=1)
+        result["deliver_tpu"] = mod.run_deliver_checks()
+        # Merge, don't overwrite: the artifact also carries the dated
+        # CPU --interpret deliver verdict from CI hosts.
+        mod._merge_out(os.path.join(here, "PALLAS_VALIDATION.json"), result)
         return result
     except Exception as e:  # record, don't kill the bench line
         return {"error": repr(e)}
@@ -753,6 +781,9 @@ def main() -> int:
             # 50M single- vs multi-rumor twins: the measured marginal
             # cost of the rumor axis at scale (ISSUE 8).
             capture_multirumor_50m(result["detail"], args.seed)
+            # -deliver-kernel fused-vs-XLA wall-clock twins at 50M/100M
+            # (ISSUE 9; dated skips re-queue when the pool is down).
+            capture_deliver_kernel_twins(result["detail"], args.seed)
             # Refresh the salvage so a worker fault in the near-ceiling
             # 100M rows can't discard the just-measured sharded twins.
             with open(partial, "w") as fh:

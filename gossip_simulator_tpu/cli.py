@@ -38,6 +38,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         from gossip_simulator_tpu.utils import jaxsetup
 
         jaxsetup.setup()
+        # Resolve the delivery-kernel gate once, post-setup (the probe
+        # imports jax), and name the auto fallback so it is never silent.
+        why = cfg.deliver_kernel_fallback_reason
+        if why and cfg.progress:
+            print(f"deliver-kernel auto -> xla: {why}", file=sys.stderr)
         if cfg.distributed:
             # Every process runs this same CLI; jax.distributed wires them
             # into one global runtime and the sharded backend's mesh spans

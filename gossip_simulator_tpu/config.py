@@ -197,6 +197,16 @@ class Config:
     # the bootstrap schedule, strictly CLOSER to the reference's burst);
     # "off" reproduces the pre-round-7 staggered schedule exactly.
     overlay_static_boot: str = "auto"
+    # Delivery kernel for the mailbox sort/rank/scatter chain (ROADMAP
+    # item 5): "pallas" runs the fused single-pass kernels
+    # (ops/pallas_deliver -- natively on TPU, interpret mode elsewhere;
+    # bit-identical mailboxes/counts/drops, A/B-pinned by trajectory
+    # fingerprints); "xla" is the recorded sort + segment-rank + scatter
+    # chain and reproduces every prior trajectory bit-for-bit; "auto"
+    # picks pallas only when the one-shot TPU capability probe passes
+    # on-device parity, else xla with a named reason
+    # (deliver_kernel_fallback_reason).
+    deliver_kernel: str = "auto"
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
@@ -426,6 +436,38 @@ class Config:
     def overlay_dead_skip_resolved(self) -> bool:
         return self.overlay_dead_skip != "off"
 
+    @property
+    def deliver_kernel_resolved(self) -> str:
+        """"xla" or "pallas" -- resolved LAZILY (first model-build time,
+        after jaxsetup.setup(); validate() must not import jax).  Explicit
+        "pallas" raises with the probe's named reason when this host
+        cannot run the kernels at all (broken interpret build, or TPU
+        lowering/parity failure on a TPU host); "auto" enables pallas
+        only on TPU hosts that pass the on-device parity probe -- CPU
+        hosts stay on xla because the interpret-mode kernels are a
+        correctness/CI surface, not a fast path."""
+        if self.deliver_kernel == "xla":
+            return "xla"
+        from gossip_simulator_tpu.ops import pallas_deliver
+        if self.deliver_kernel == "pallas":
+            why = pallas_deliver.kernel_unavailable_reason()
+            if why:
+                raise ValueError(
+                    f"-deliver-kernel pallas is unavailable on this host: "
+                    f"{why} (use -deliver-kernel xla or auto)")
+            return "pallas"
+        return "xla" if pallas_deliver.tpu_unsupported() else "pallas"
+
+    @property
+    def deliver_kernel_fallback_reason(self) -> str:
+        """Non-empty iff `-deliver-kernel auto` resolved to xla: the
+        probe's named reason (e.g. 'no TPU backend (...)'), surfaced by
+        the driver so the fallback is never silent."""
+        if self.deliver_kernel != "auto":
+            return ""
+        from gossip_simulator_tpu.ops import pallas_deliver
+        return pallas_deliver.tpu_unsupported()
+
     def static_boot_for(self, n_rows: int) -> bool:
         """One-shot static bootstrap for a ROUNDS-overlay surface of
         `n_rows` rows (single-device engine only; the sharded hook path
@@ -571,6 +613,10 @@ class Config:
             v = getattr(self, name)
             if v not in ("auto", "on", "off"):
                 raise ValueError(f"{name} must be auto|on|off, got {v!r}")
+        if self.deliver_kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"deliver_kernel must be auto|xla|pallas, "
+                f"got {self.deliver_kernel!r}")
         if self.dup_suppress == "on" and self.crashrate_eff > 0.0:
             raise ValueError(
                 "-dup-suppress on requires an effective crash rate of 0 "
@@ -815,6 +861,15 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="one-shot bootstrap burst for the rounds overlay "
                         "(auto = on at >= 32M rows; off reproduces the "
                         "staggered per-round schedule)")
+    p.add_argument("-deliver-kernel", "--deliver-kernel",
+                   dest="deliver_kernel", choices=("auto", "xla", "pallas"),
+                   default=d.deliver_kernel,
+                   help="mailbox delivery kernel: pallas fuses the "
+                        "sort/rank/scatter chain into one pass "
+                        "(bit-identical, A/B-pinned); xla reproduces "
+                        "prior trajectories bit-for-bit; auto = pallas "
+                        "only when the TPU capability probe passes, else "
+                        "xla with a named reason")
     p.add_argument("-telemetry", "--telemetry", choices=("on", "off"),
                    default=d.telemetry,
                    help="device-resident per-window telemetry on fast-path "

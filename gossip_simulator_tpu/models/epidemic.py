@@ -349,6 +349,7 @@ def deposit_compact(cfg: Config, pending, friends, friend_cnt,
     the loop carry is untouched) when no partitions are configured."""
     n, k = friends.shape
     cap = compact_chunk_cap(cfg, n)
+    dkern = cfg.deliver_kernel_resolved
     count = senders.sum(dtype=I32)
     chunks = (count + cap - 1) // cap
     if cfg.scenario_resolved.has_partitions:
@@ -357,8 +358,8 @@ def deposit_compact(cfg: Config, pending, friends, friend_cnt,
             dst, slots, valid, remaining, b = compact_gather(
                 cfg, friends, friend_cnt, dslot, delay_key, drop_key,
                 tick, remaining, cap)
-            return deposit_local(pending, dst, slots, valid), remaining, \
-                blk + b
+            return deposit_local(pending, dst, slots, valid,
+                                 kernel=dkern), remaining, blk + b
 
         pending, _, blk = jax.lax.fori_loop(
             0, chunks, body_p, (pending, senders, jnp.zeros((), I32)))
@@ -369,13 +370,14 @@ def deposit_compact(cfg: Config, pending, friends, friend_cnt,
         dst, slots, valid, remaining, _ = compact_gather(
             cfg, friends, friend_cnt, dslot, delay_key, drop_key, tick,
             remaining, cap)
-        return deposit_local(pending, dst, slots, valid), remaining
+        return deposit_local(pending, dst, slots, valid,
+                             kernel=dkern), remaining
 
     pending, _ = jax.lax.fori_loop(0, chunks, body, (pending, senders))
     return pending, 0
 
 
-def deposit_local(pending, dst_local, slots, valid):
+def deposit_local(pending, dst_local, slots, valid, kernel="xla"):
     """Scatter arrivals into the pending ring (idempotent counting add;
     duplicates accumulate like the reference's per-message channel sends).
 
@@ -384,23 +386,38 @@ def deposit_local(pending, dst_local, slots, valid):
     on the axon TPU stack the OOB-drop of the flattened index was observed
     being ignored inside the jitted tick (every edge delivered, drops
     bypassed -- TPU canary in the verify skill catches it); the 2-D form is
-    the one proven correct there."""
+    the one proven correct there.  kernel="pallas" routes to the fused
+    serial add (ops/pallas_deliver.fused_deposit_add) whose in-range check
+    replaces the scatter's OOB-drop explicitly -- integer adds commute, so
+    it is bit-identical (and immune to that miscompile class by
+    construction)."""
     n = pending.shape[1]
     dst = jnp.where(valid, dst_local, n)  # out of bounds -> mode="drop"
+    if kernel == "pallas":
+        from gossip_simulator_tpu.ops import pallas_deliver
+        return pallas_deliver.fused_deposit_add(pending, slots, dst)
     return pending.at[slots, dst].add(1, mode="drop")
 
 
-def deposit_rumors(pending_rumors, dst_local, slots, valid, newbits):
+def deposit_rumors(pending_rumors, dst_local, slots, valid, newbits,
+                   kernel="xla"):
     """Multi-rumor companion to deposit_local: each kept edge adds its
     sender's NEW rumor bits (one-hot int rows) into the destination's
     (slot, dst) per-rumor lane.  Same 2-D leading-index scatter form as
     deposit_local (see the axon NOTE there); the R axis rides as the
-    scatter's trailing window dimension."""
+    scatter's trailing window dimension.  kernel="pallas" applies the
+    whole R-row add in-register at the shared (slot, dst) cell
+    (fused_deposit_rows) -- the multi-rumor combine rides the fused pass
+    for free."""
     n, r = newbits.shape
     k = dst_local.shape[0] // n
     vals = jnp.broadcast_to(newbits[:, None, :].astype(I32),
                             (n, k, r)).reshape(n * k, r)
     dst = jnp.where(valid, dst_local, pending_rumors.shape[1])
+    if kernel == "pallas":
+        from gossip_simulator_tpu.ops import pallas_deliver
+        return pallas_deliver.fused_deposit_rows(
+            pending_rumors, slots, dst, vals)
     return pending_rumors.at[slots, dst].add(vals, mode="drop")
 
 
@@ -415,6 +432,7 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     # epidemic stalled).  Root-caused 2026-07-30; the skip also measured no
     # wall-clock win (empty slots are rare once delays spread the wave).
     multi = cfg.multi_rumor
+    dkern = cfg.deliver_kernel_resolved
     if multi:
         target = int(math.ceil(cfg.coverage_target * cfg.n))
 
@@ -431,9 +449,11 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
             dst, slots, valid, blk = edges_from_senders(
                 cfg, stp.friends, stp.friend_cnt, senders, dslot,
                 keys["drop"], tick=st.tick)
-            pending = deposit_local(stp.pending, dst, slots, valid)
+            pending = deposit_local(stp.pending, dst, slots, valid,
+                                    kernel=dkern)
             stp = stp._replace(pending_rumors=deposit_rumors(
-                stp.pending_rumors, dst, slots, valid, newbits))
+                stp.pending_rumors, dst, slots, valid, newbits,
+                kernel=dkern))
             hit = (stp.rumor_recv >= target) & (stp.rumor_done < 0)
             stp = stp._replace(rumor_done=jnp.where(
                 hit, stp.tick, stp.rumor_done))
@@ -447,7 +467,8 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
                 dst, slots, valid, blk = edges_from_senders(
                     cfg, stp.friends, stp.friend_cnt, senders, dslot,
                     keys["drop"], tick=st.tick)
-                pending = deposit_local(stp.pending, dst, slots, valid)
+                pending = deposit_local(stp.pending, dst, slots, valid,
+                                        kernel=dkern)
         stp = stp._replace(
             pending=pending,
             total_message=msg64_add(stp.total_message, dm),

@@ -417,7 +417,7 @@ def _sender_keys(base_key, op: int, ticks, rows):
 def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
                     svalid, sticks, friends, friend_cnt, base_key,
                     strig=None, flags=None, gid0=0, swords=None,
-                    mail_words=None):
+                    mail_words=None, kernel: str = "xla"):
     """Emit each sender's broadcast (k sends, ONE shared delay drawn at its
     delivery tick -- simulator.go:141-142) into the packed mail ring.
 
@@ -545,15 +545,29 @@ def append_messages(cfg: Config, mail_ids, mail_cnt, dropped, sender_ids,
     flat = jnp.where(edge & ok[:, None],
                      wslot[:, None] * cap + start[:, None] + cols,
                      dw * cap + lane)
-    mail_ids = mail_ids.at[flat.reshape(-1)].set(
-        jnp.where(edge, payload, 0).reshape(-1), unique_indices=True)
+    ivals = jnp.where(edge, payload, 0).reshape(-1)
     if swords is not None:
         wvals = jnp.where(edge[:, :, None],
                           jnp.broadcast_to(swords[:, None, :],
                                            edge.shape + swords.shape[-1:]),
-                          jnp.uint32(0))
-        mail_words = mail_words.at[flat.reshape(-1)].set(
-            wvals.reshape(-1, swords.shape[-1]), unique_indices=True)
+                          jnp.uint32(0)).reshape(-1, swords.shape[-1])
+    if kernel == "pallas":
+        # Fused dual-ring write: id ring and word ring share their unique
+        # reservation positions, so one serial pass writes both (order
+        # immaterial -- bit-identical to the unique_indices scatters).
+        from gossip_simulator_tpu.ops import pallas_deliver
+        if swords is not None:
+            mail_ids, mail_words = pallas_deliver.fused_unique_set(
+                (mail_ids, mail_words), flat.reshape(-1), (ivals, wvals))
+        else:
+            (mail_ids,) = pallas_deliver.fused_unique_set(
+                (mail_ids,), flat.reshape(-1), (ivals,))
+    else:
+        mail_ids = mail_ids.at[flat.reshape(-1)].set(
+            ivals, unique_indices=True)
+        if swords is not None:
+            mail_words = mail_words.at[flat.reshape(-1)].set(
+                wvals, unique_indices=True)
     # Overflowed senders are a per-slot suffix (start grows monotonically
     # within a slot), so counting only written reservations keeps
     # positions contiguous.
@@ -996,6 +1010,9 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
     multi = cfg.multi_rumor
     if multi:
         from gossip_simulator_tpu.ops.mailbox import ring_append
+    # Resolved at BUILD time: the pallas capability probe must run eagerly
+    # (ops/pallas_deliver._probe_case), never inside the trace below.
+    dkern = cfg.deliver_kernel_resolved
 
     def step_fn(st: EventState, base_key: jax.Array) -> EventState:
         n = st.flags.shape[0]
@@ -1012,7 +1029,7 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
             (mi, mw), icnt, idrop = ring_append(
                 (st.mail_ids, st.mail_words), st.mail_cnt,
                 st.mail_dropped, (ipay, iwords), iwslot, ivalid, dw,
-                icap)
+                icap, kernel=dkern)
             st = st._replace(mail_ids=mi, mail_words=mw, mail_cnt=icnt,
                              mail_dropped=idrop)
         m = st.mail_cnt[0, slot]
@@ -1141,14 +1158,15 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                                 cfg, amail_ids, amail_cnt, adropped,
                                 sids, svalid, stick2, st.friends,
                                 st.friend_cnt, base_key, swords=sw,
-                                mail_words=awords)
+                                mail_words=awords, kernel=dkern)
                         else:
                             (amail_ids, amail_cnt, adropped, sa,
                              ablk) = append_messages(
                                 cfg, amail_ids, amail_cnt, adropped,
                                 sids, svalid, stick2, st.friends,
                                 st.friend_cnt, base_key, strig=strig,
-                                flags=aflags if suppress else None)
+                                flags=aflags if suppress else None,
+                                kernel=dkern)
                         out = (aflags, amail_ids, amail_cnt,
                                asup + sa[None, :], adropped)
                         if track_part:
@@ -1205,13 +1223,13 @@ def make_window_step_fn(cfg: Config, n_local: int | None = None):
                     cfg, mail_ids, mail_cnt, dropped,
                     jnp.where(senders, ids_s, 0), senders, sticks,
                     st.friends, st.friend_cnt, base_key,
-                    swords=delta_w, mail_words=mail_words)
+                    swords=delta_w, mail_words=mail_words, kernel=dkern)
             else:
                 mail_ids, mail_cnt, dropped, sa, blk = append_messages(
                     cfg, mail_ids, mail_cnt, dropped,
                     jnp.where(senders, ids_s, 0), senders, sticks,
                     st.friends, st.friend_cnt, base_key, strig=strig,
-                    flags=flags if suppress else None)
+                    flags=flags if suppress else None, kernel=dkern)
             if track_part:
                 part = part + blk
             return pack((flags, mail_ids, mail_cnt, sup_cnt + sa[None, :],
@@ -1366,6 +1384,8 @@ def make_heal_fn(cfg: Config, n_local: int | None = None):
     detect = cfg.heal_detect_ms
     multi = cfg.multi_rumor
 
+    dkern = cfg.deliver_kernel_resolved
+
     def heal_fn(st: EventState, base_key: jax.Array) -> EventState:
         n, k = st.friends.shape
         ids = jnp.arange(n, dtype=I32)
@@ -1395,25 +1415,25 @@ def make_heal_fn(cfg: Config, n_local: int | None = None):
             (mail, mailw), cnt, dropped = ring_append(
                 (st.mail_ids, st.mail_words), st.mail_cnt,
                 st.mail_dropped, (payload, rw), wslot,
-                resend.reshape(-1), dw, cap)
+                resend.reshape(-1), dw, cap, kernel=dkern)
             ppay = jnp.broadcast_to((ids * b)[:, None] + off,
                                     (n, k)).reshape(-1)
             fw = st.rumor_words[jnp.where(friends >= 0, friends,
                                           0)].reshape(-1, wc)
             (mail, mailw), cnt, dropped = ring_append(
                 (mail, mailw), cnt, dropped, (ppay, fw), wslot,
-                pull.reshape(-1), dw, cap)
+                pull.reshape(-1), dw, cap, kernel=dkern)
             st = st._replace(mail_words=mailw)
         else:
             (mail,), cnt, dropped = ring_append(
                 (st.mail_ids,), st.mail_cnt, st.mail_dropped, (payload,),
-                wslot, resend.reshape(-1), dw, cap)
+                wslot, resend.reshape(-1), dw, cap, kernel=dkern)
             # Rejoin pull responses deliver to the puller's OWN row.
             ppay = jnp.broadcast_to((ids * b)[:, None] + off,
                                     (n, k)).reshape(-1)
             (mail,), cnt, dropped = ring_append(
                 (mail,), cnt, dropped, (ppay,), wslot, pull.reshape(-1),
-                dw, cap)
+                dw, cap, kernel=dkern)
         return st._replace(
             friends=friends, mail_ids=mail, mail_cnt=cnt,
             mail_dropped=dropped,

@@ -396,6 +396,7 @@ def make_round_fn(cfg: Config,
         from gossip_simulator_tpu.ops.mailbox import (deliver_columns,
                                                       flat_addressing_fits)
 
+        dkern = cfg.deliver_kernel_resolved
         sc_band = spill_cap_for(cfg, n)
         if n > COLUMN_DELIVERY_MIN_ROWS and flat_addressing_fits(n, cap):
             # Per-SLOT delivery: same entries at ~1/slots the compaction
@@ -422,13 +423,13 @@ def make_round_fn(cfg: Config,
                              jnp.zeros((), I32))
                 if sc_band == 0:
                     out = deliver_columns(mats, n, cap, dchunk, flat=True,
-                                          carry=carry)
+                                          carry=carry, kernel=dkern)
                     return out + (None,)
                 acc = (jnp.full((2, sc_band + 1), -1, I32),
                        jnp.zeros((), I32))
                 mbox, load, dropped, (pairs, _) = deliver_columns(
                     mats, n, cap, dchunk, flat=True, carry=carry,
-                    spill_in=spill_in, spill=acc)
+                    spill_in=spill_in, spill=acc, kernel=dkern)
                 return mbox, load, dropped, pairs
         else:
             # Small-n path, and past the flat-addressing boundary the
@@ -442,7 +443,7 @@ def make_round_fn(cfg: Config,
                 flat = jnp.concatenate(mats, axis=0).reshape(-1)
                 mbox, cnt, dropped = deliver(None, flat, flat >= 0, n, cap,
                                              compact_chunk=dchunk,
-                                             src_mod=n)
+                                             src_mod=n, kernel=dkern)
                 return mbox, cnt.max(initial=0), dropped, None
     else:
         # Hook supplied (the sharded backend's routed delivery): keep its
@@ -684,7 +685,8 @@ def make_split_round_fn(cfg: Config):
     dead_skip = cfg.overlay_dead_skip_resolved
     sc_split = spill_cap_for(cfg, n)
     hosted_deliver = make_hosted_column_delivery(
-        n, cap, hosted_chunk_widths(cfg, n), spill_cap=sc_split)
+        n, cap, hosted_chunk_widths(cfg, n), spill_cap=sc_split,
+        kernel=cfg.deliver_kernel_resolved)
 
     # bk_mbox is not donated for the same reason as b2_fn's mk_mbox (no
     # same-shaped output to alias; liveness frees it after the slot loop).

@@ -212,7 +212,7 @@ def _append(cfg: Config, ring_dst, ring_pay, ring_cnt, dropped,
     cap = (ring_dst.shape[0] - 1) // dw
     (ring_dst, ring_pay), ring_cnt, dropped = ring_append(
         (ring_dst, ring_pay), ring_cnt, dropped, (dst, pay), wslot, valid,
-        dw, cap)
+        dw, cap, kernel=cfg.deliver_kernel_resolved)
     return ring_dst, ring_pay, ring_cnt, dropped
 
 
@@ -425,15 +425,16 @@ def make_step_fn(cfg: Config, n_local: int | None = None, ids_fn=None,
         # last window's overflow pairs re-deliver first and this window's
         # overflow accumulates instead of dropping.
         plen = m_live if prefix else None
+        dkern = cfg.deliver_kernel_resolved
         if sc > 0:
             acc = (jnp.full((2, sc + 1), -1, I32), jnp.zeros((), I32))
             return deliver_pair(src_pay, dst, typ, evalid, n_rows, cap_mb,
                                 compact_chunk=dchunk, flat=sm,
                                 prefix_len=plen, spill_in=spill_in,
-                                spill=acc)
+                                spill=acc, kernel=dkern)
         return deliver_pair(src_pay, dst, typ, evalid, n_rows, cap_mb,
                             compact_chunk=dchunk, flat=sm,
-                            prefix_len=plen) + (None,)
+                            prefix_len=plen, kernel=dkern) + (None,)
 
     def _drain_at_width(width, ring_dst, ring_pay, slot, m, spill_in):
         """Drain one window slot through a `width`-lane sort + delivery.

@@ -41,7 +41,7 @@ def flat_addressing_fits(n: int, cap: int) -> bool:
 
 
 def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
-                cap: int):
+                cap: int, kernel: str = "xla"):
     """Append one entry per True in `valid` into its `wslot` window slot of
     the packed ring(s): one-hot reservation ranks (emission order, no
     gathers -- dw is tiny), bounds-checked against the slot capacity, with
@@ -59,7 +59,16 @@ def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
     (M, W) and the shared flat positions scatter whole rows.  Shared by
     parallel/event_sharded._ring_append and models/overlay_ticks;
     models/event.append_messages keeps its own multi-entry-per-row
-    reservation variant."""
+    reservation variant.
+
+    `kernel="pallas"` routes to the fused single-pass form
+    (ops/pallas_deliver.fused_ring_append) -- bit-identical slot writes,
+    counts, and drop totals (the -deliver-kernel gate; see the module
+    docstring there for the equivalence argument)."""
+    if kernel == "pallas":
+        from gossip_simulator_tpu.ops import pallas_deliver
+        return pallas_deliver.fused_ring_append(
+            rings, cnt, dropped, payloads, wslot, valid, dw, cap)
     oh = ((wslot[:, None] == jnp.arange(dw, dtype=jnp.int32)[None, :])
           & valid[:, None]).astype(jnp.int32)
     rank = (jnp.cumsum(oh, axis=0) * oh).sum(axis=1) - 1
@@ -94,7 +103,8 @@ def segment_ranks(sorted_keys: jnp.ndarray) -> jnp.ndarray:
 
 def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
             n: int, cap: int, compact_chunk: int | None = None,
-            src_cols: int | None = None, src_mod: int | None = None):
+            src_cols: int | None = None, src_mod: int | None = None,
+            kernel: str = "xla"):
     """Deliver messages into per-destination mailboxes.
 
     Args:
@@ -120,6 +130,11 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
             form: chunks are ascending index ranges, so the global stable
             order is preserved, and per-node ranks continue across chunks
             via a total-arrivals counter.
+        kernel: "xla" (the sort + rank + scatter chain below) or "pallas"
+            (the fused single-pass kernel, ops/pallas_deliver) -- the
+            -deliver-kernel gate, bit-identical mailboxes/counts/drops.
+            The dense 2-D fallback (flat addressing overflow) always runs
+            the XLA form.
 
     Returns:
         mbox: int32[n, cap] -- sender ids, -1 padded.  Slot order is arrival
@@ -142,7 +157,8 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
     if compact_chunk is not None and compact_chunk < m:
         if flat_addressing_fits(n, cap):
             return _deliver_compact(src, dst, valid, n, cap, compact_chunk,
-                                    src_cols=src_cols, src_mod=src_mod)
+                                    src_cols=src_cols, src_mod=src_mod,
+                                    kernel=kernel)
         # Flat int32 addressing no longer fits: the requested compaction is
         # ignored and the full-length sort + 2-D scatter path below runs
         # (~15x slower per the NOTE).  Without a signal this reads as an
@@ -161,6 +177,18 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
                if src_cols is None
                else jnp.arange(m, dtype=jnp.int32) // src_cols)
     key = jnp.where(valid, dst, n).astype(jnp.int32)
+    if kernel == "pallas" and flat_addressing_fits(n, cap):
+        # One full-width fused chunk with an empty carry reproduces the
+        # single-pass result exactly: the fused step's count is TOTAL
+        # arrivals (can exceed cap), so clamp to match the ok-only count
+        # below -- both equal min(arrivals, cap) per destination.
+        mbox, cnt, dropped = _compact_chunk_step(
+            jnp.full((n * cap + 1,), -1, dtype=jnp.int32),
+            jnp.zeros((n + 1,), dtype=jnp.int32),
+            jnp.zeros((), jnp.int32), key, src.astype(jnp.int32), n, cap,
+            rank_major=False, kernel=kernel)
+        return (mbox[:n * cap].reshape(n, cap),
+                jnp.minimum(cnt[:n], cap), dropped)
     sd, ss = jax.lax.sort((key, src.astype(jnp.int32)), num_keys=1,
                           is_stable=True)
     rank = segment_ranks(sd)
@@ -185,7 +213,8 @@ def deliver(src: jnp.ndarray | None, dst: jnp.ndarray, valid: jnp.ndarray,
 
 
 def _deliver_prefix_keyed(src, key_full, live, nk, cap, chunk,
-                          carry=None, rank_major=False, spill=None):
+                          carry=None, rank_major=False, spill=None,
+                          kernel="xla"):
     """Chunked delivery of a prepacked-key stream whose valid entries are a
     known-length PREFIX (`live`, an int32 scalar): chunks are plain
     ascending index ranges with NO per-chunk compaction scan --
@@ -211,10 +240,10 @@ def _deliver_prefix_keyed(src, key_full, live, nk, cap, chunk,
         if spill is not None:
             mbox, count, dropped, (pairs, scnt) = _compact_chunk_step(
                 mbox, count, dropped, key, s, nk, cap, rank_major,
-                spill=(pairs, scnt))
+                spill=(pairs, scnt), kernel=kernel)
             return mbox, count, dropped, pairs, scnt
         return _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
-                                   rank_major)
+                                   rank_major, kernel=kernel)
 
     if carry is None:
         carry = (jnp.full((nk * cap + 1,), -1, dtype=jnp.int32),
@@ -228,7 +257,8 @@ def _deliver_prefix_keyed(src, key_full, live, nk, cap, chunk,
 
 def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
                  compact_chunk: int | None = None, flat: bool = False,
-                 prefix_len=None, spill_in=None, spill=None):
+                 prefix_len=None, spill_in=None, spill=None,
+                 kernel: str = "xla"):
     """Deliver a two-TYPE message stream into two mailbox sets in ONE
     sorted pass: key (typ, dst) packed as typ*n + dst, shared compaction,
     one stable sort, one scatter into a stacked [2n, cap] buffer split
@@ -271,9 +301,9 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
         assert spill is None and spill_in is None, \
             "deliver_pair spill requires stacked flat addressing"
         m0, _, d0 = deliver(src, dst, evalid & (typ == 0), n, cap,
-                            compact_chunk)
+                            compact_chunk, kernel=kernel)
         m1, _, d1 = deliver(src, dst, evalid & (typ == 1), n, cap,
-                            compact_chunk)
+                            compact_chunk, kernel=kernel)
         return m0, m1, d0 + d1
     key_full = jnp.where(evalid, typ * n + dst, n2).astype(jnp.int32)
     spilling = spill is not None or spill_in is not None
@@ -287,15 +317,17 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
                      jnp.zeros((n2 + 1,), dtype=jnp.int32),
                      jnp.zeros((), jnp.int32))
             carry, spill = deliver_spill_pairs(carry, spill_in, n2, cap,
-                                               rank_major=flat, spill=spill)
+                                               rank_major=flat, spill=spill,
+                                               kernel=kernel)
         if prefix_len is not None:
             out = _deliver_prefix_keyed(src, key_full, prefix_len, n2, cap,
                                         chunk, carry=carry, rank_major=flat,
-                                        spill=spill)
+                                        spill=spill, kernel=kernel)
         else:
             out = _deliver_compact_keyed(src, key_full, evalid, n2, cap,
                                          chunk, carry=carry,
-                                         rank_major=flat, spill=spill)
+                                         rank_major=flat, spill=spill,
+                                         kernel=kernel)
         if spill is not None:
             mbox, count, dropped, spill_out = out
         else:
@@ -312,11 +344,20 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
         if prefix_len is not None:
             mbox, count, dropped = _deliver_prefix_keyed(
                 src, key_full, prefix_len, n2, cap, compact_chunk,
-                rank_major=flat)
+                rank_major=flat, kernel=kernel)
         else:
             mbox, count, dropped = _deliver_compact_keyed(
                 src, key_full, evalid, n2, cap, compact_chunk,
-                rank_major=flat)
+                rank_major=flat, kernel=kernel)
+    elif kernel == "pallas":
+        # One full-width fused chunk with an empty carry == the
+        # single-pass sort form (same count semantics: every lane adds,
+        # sentinel included).
+        mbox, count, dropped = _compact_chunk_step(
+            jnp.full((n2 * cap + 1,), -1, dtype=jnp.int32),
+            jnp.zeros((n2 + 1,), dtype=jnp.int32), jnp.zeros((), jnp.int32),
+            key_full, src.astype(jnp.int32), n2, cap, rank_major=flat,
+            kernel=kernel)
     else:
         sd, ss = jax.lax.sort((key_full, src.astype(jnp.int32)),
                               num_keys=1, is_stable=True)
@@ -341,7 +382,7 @@ def deliver_pair(src, dst, typ, evalid, n: int, cap: int,
 
 
 def _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
-                        rank_major, spill=None):
+                        rank_major, spill=None, kernel="xla"):
     """ONE compaction chunk's delivery: stable sort by key, rank
     continuation via the total-arrivals counter, capacity-checked flat
     scatter (trash cell at nk*cap), count/drop updates.  THE shared body
@@ -356,7 +397,21 @@ def _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
     the reference's channel-full backpressure (senders block; membership
     traffic is delayed, never lost -- simulator.go:51-54).  Only messages
     past the SPILL capacity fall through to `dropped` (counted, never
-    silent).  Returns (mbox, count, dropped[, spill])."""
+    silent).  Returns (mbox, count, dropped[, spill]).
+
+    `kernel="pallas"` replaces the whole sort -> segment_ranks -> scatter
+    chain with the fused single-pass kernel (ops/pallas_deliver.
+    fused_chunk_step): every chunked delivery path in the repo funnels
+    through this one body, so the -deliver-kernel gate lives HERE and the
+    fused/XLA bit-identity is structural for all of them.  Mailboxes,
+    counts, and drop totals are bit-identical; the only at-rest divergence
+    is the spill pair buffer's internal order (arrival vs sorted -- a
+    within-destination-order-preserving permutation, so re-delivery
+    produces identical mailboxes; see README divergence table)."""
+    if kernel == "pallas":
+        from gossip_simulator_tpu.ops import pallas_deliver
+        return pallas_deliver.fused_chunk_step(
+            mbox, count, dropped, key, s, nk, cap, rank_major, spill=spill)
     sd, ss = jax.lax.sort((key, s.astype(jnp.int32)), num_keys=1,
                           is_stable=True)
     rank = segment_ranks(sd) + count[jnp.minimum(sd, nk)]
@@ -383,7 +438,7 @@ def _compact_chunk_step(mbox, count, dropped, key, s, nk, cap,
 
 def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
                            src_cols=None, src_mod=None, carry=None,
-                           rank_major=False, spill=None):
+                           rank_major=False, spill=None, kernel="xla"):
     """Chunked-compacted delivery on a prepacked key in [0, nk) with nk
     the invalid sentinel -- the ONE chunked work-horse behind
     _deliver_compact (key = dst), deliver_pair (key = typ*n + dst) and
@@ -427,10 +482,11 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
         if spill is not None:
             mbox, count, dropped, (pairs, scnt) = _compact_chunk_step(
                 mbox, count, dropped, key, s, nk, cap, rank_major,
-                spill=(pairs, scnt))
+                spill=(pairs, scnt), kernel=kernel)
             return mbox, count, dropped, pairs, scnt, remaining
         mbox, count, dropped = _compact_chunk_step(
-            mbox, count, dropped, key, s, nk, cap, rank_major)
+            mbox, count, dropped, key, s, nk, cap, rank_major,
+            kernel=kernel)
         return mbox, count, dropped, remaining
 
     if carry is None:
@@ -446,7 +502,7 @@ def _deliver_compact_keyed(src, key_full, valid, nk, cap, chunk,
 
 
 def deliver_spill_pairs(carry, pairs, n: int, cap: int, rank_major: bool,
-                        spill=None):
+                        spill=None, kernel="xla"):
     """Deliver an explicit (src, dst) pair list -- last round's
     capacity-overflow spill -- as ONE sorted chunk step, chained BEFORE
     the round's emission matrices through the same carry (delayed
@@ -458,7 +514,7 @@ def deliver_spill_pairs(carry, pairs, n: int, cap: int, rank_major: bool,
     dst = pairs[1]
     key = jnp.where(dst >= 0, dst, n).astype(jnp.int32)
     out = _compact_chunk_step(mbox, count, dropped, key, pairs[0], n, cap,
-                              rank_major, spill=spill)
+                              rank_major, spill=spill, kernel=kernel)
     if spill is None:
         return out, None
     return out[:3], out[3]
@@ -466,7 +522,7 @@ def deliver_spill_pairs(carry, pairs, n: int, cap: int, rank_major: bool,
 
 def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int,
                     flat: bool = False, carry=None, spill_in=None,
-                    spill=None):
+                    spill=None, kernel: str = "xla"):
     """Per-SLOT chunked delivery of a (slots, n) emission matrix whose
     sender id is the lane (column) index.
 
@@ -502,18 +558,20 @@ def deliver_columns(dst_mat: jnp.ndarray, n: int, cap: int, chunk: int,
     _compact_chunk_step) -- the return gains the final accumulator."""
     mats = dst_mat if isinstance(dst_mat, (tuple, list)) else (dst_mat,)
     return _deliver_columns_impl(mats, n, cap, chunk, flat, carry,
-                                 spill_in=spill_in, spill=spill)
+                                 spill_in=spill_in, spill=spill,
+                                 kernel=kernel)
 
 
 def _deliver_columns_impl(mats, n, cap, chunk, flat, carry, spill_in=None,
-                          spill=None):
+                          spill=None, kernel="xla"):
     if carry is None:
         carry = (jnp.full((n * cap + 1,), -1, dtype=jnp.int32),
                  jnp.zeros((n + 1,), dtype=jnp.int32),
                  jnp.zeros((), jnp.int32))
     if spill_in is not None:
         carry, spill = deliver_spill_pairs(carry, spill_in, n, cap,
-                                           rank_major=flat, spill=spill)
+                                           rank_major=flat, spill=spill,
+                                           kernel=kernel)
     for mat in mats:
         for c in range(mat.shape[0]):
             dcol = mat[c]
@@ -522,7 +580,8 @@ def _deliver_columns_impl(mats, n, cap, chunk, flat, carry, spill_in=None,
             # like the chunk continuation within one call.
             out = _deliver_compact_keyed(None, dcol, dcol >= 0, n, cap,
                                          chunk, src_cols=1, carry=carry,
-                                         rank_major=flat, spill=spill)
+                                         rank_major=flat, spill=spill,
+                                         kernel=kernel)
             if spill is not None:
                 carry, spill = out[:3], out[3]
             else:
@@ -537,7 +596,7 @@ def _deliver_columns_impl(mats, n, cap, chunk, flat, carry, spill_in=None,
 
 def make_hosted_column_delivery(n: int, cap: int, chunk,
                                 per_call_chunks: int = 256,
-                                spill_cap: int = 0):
+                                spill_cap: int = 0, kernel: str = "xla"):
     """deliver_columns(flat=True) as a HOST-driven sequence of bounded
     device calls -- the memory-scale overlay's delivery (overlay.
     make_split_round_fn).  One fused delivery of a full emission row is
@@ -591,7 +650,8 @@ def make_hosted_column_delivery(n: int, cap: int, chunk,
         key = dcol.at[idx].get(mode="fill", fill_value=n)
         key = jnp.where(v, key, n)
         return _compact_chunk_step(mbox, count, dropped, key, s, n, cap,
-                                   rank_major=True, spill=spill)
+                                   rank_major=True, spill=spill,
+                                   kernel=kernel)
 
     def _make_ksteps(chunk_w: int):
         @functools.partial(jax.jit,
@@ -680,7 +740,7 @@ def make_hosted_column_delivery(n: int, cap: int, chunk,
         carry, sp = deliver_spill_pairs((mbox, count, dropped),
                                         spill_pairs, n, cap,
                                         rank_major=True,
-                                        spill=(pairs, scnt))
+                                        spill=(pairs, scnt), kernel=kernel)
         return carry + sp
 
     def run(mats, spill_in=None, row_totals=None):
@@ -752,11 +812,11 @@ def make_hosted_column_delivery(n: int, cap: int, chunk,
 
 
 def _deliver_compact(src, dst, valid, n, cap, chunk, src_cols=None,
-                     src_mod=None):
+                     src_mod=None, kernel="xla"):
     """Chunked-compacted deliver (see deliver's compact_chunk)."""
     key_full = jnp.where(valid, dst, n).astype(jnp.int32)
     mbox, count, dropped = _deliver_compact_keyed(
         src, key_full, valid, n, cap, chunk, src_cols=src_cols,
-        src_mod=src_mod)
+        src_mod=src_mod, kernel=kernel)
     return (mbox[:n * cap].reshape(n, cap),
             jnp.minimum(count[:n], cap), dropped)

@@ -1,0 +1,473 @@
+"""Fused Pallas delivery kernels: the sort/rank/scatter chain as ONE pass.
+
+Every delivery in both engines decomposes, on the XLA path, into a stable
+sort by destination key, a segment-rank pass, and a flat scatter with a
+trash cell -- three full-array ops whose per-op floors PROFILE_OVERLAY.json
+pins at ~450-490 ns/lane (flat-scatter chunk) and PROFILE_EXCHANGE.json at
+~2747 ns/lane (drain-side sort).  The kernels here replace that chain with
+one serial pass per mailbox chunk that computes each lane's destination
+bucket rank, writes its ring slot, and applies the combine in-register --
+the fusion move of ROADMAP item 5.
+
+Why a SERIAL pass is bit-identical to sort+rank+scatter: the XLA chain's
+stable sort only ever reorders lanes BETWEEN destinations; within one
+destination the sorted order IS arrival (lane) order.  A single pass that
+keeps a per-destination arrival counter therefore assigns every lane the
+same rank, the same flat cell, and the same overflow verdict as the sorted
+form -- including the count array's junk-sentinel increments and the
+trash-cell -1 writes -- so mailboxes, counts, and drop counters match the
+XLA path bit for bit (pinned by tests/test_pallas_deliver.py).  The one
+at-rest divergence is the spill PAIR BUFFER's internal order (arrival
+order here vs sorted order on the XLA path): a within-destination
+order-preserving permutation, so re-delivery next round produces identical
+mailboxes under either kernel (see README divergence table).
+
+Combine semantics ride the same pass: mailbox/ring payloads are
+first-touch slot writes (rank < cap wins, exactly the SI bits' semantics),
+multi-rumor word rows (the PR-5 (L, W) ladder next to an (L,) id ring)
+scatter whole rows at the shared flat position, and the epidemic deposit
+kernels accumulate their integer adds in-register -- R-rumor runs get the
+fusion for free.
+
+Gate policy (config.deliver_kernel_resolved): kernels trace with
+``interpret=True`` on non-TPU backends -- that is the CPU CI parity
+mechanism, not a stub -- and lower natively on TPU only when the one-shot
+capability probe below passes on-device parity.  ``auto`` falls back to
+``xla`` with a named reason on hosts without Pallas lowering.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+I32 = jnp.int32
+
+
+def _interpret_param(interpret: bool):
+    """Pallas interpret flag across jax builds: newer builds want
+    pltpu.InterpretParams(), older ones (this container's 0.4.37) only
+    accept the boolean -- the AttributeError that used to skip the
+    pallas_graph structural tests wholesale (PR-4 probe)."""
+    if not interpret:
+        return False
+    try:  # pragma: no cover - version-dependent
+        from jax.experimental.pallas import tpu as pltpu
+        ip = getattr(pltpu, "InterpretParams", None)
+        if ip is not None:
+            return ip()
+    except ImportError:
+        pass
+    return True
+
+
+def _default_interpret() -> bool:
+    """Interpret unless we are actually on TPU (decided at trace time)."""
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Fused chunk step: mailbox._compact_chunk_step as one serial pass.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_kernel(nk: int, cap: int, rank_major: bool, scap):
+    """Kernel body for one delivery chunk (cached per static shape so
+    repeated pallas_call tracing reuses one closure).  `scap` is the spill
+    pair capacity or None for the drop-counting form."""
+
+    def kernel(*refs):
+        if scap is None:
+            (_, _, _, key_ref, s_ref,
+             mbox_ref, count_ref, drop_ref) = refs
+        else:
+            (_, _, _, _, _, key_ref, s_ref,
+             mbox_ref, count_ref, drop_ref, pr_ref, scnt_ref) = refs
+        m = key_ref.shape[0]
+
+        def body(i, _):
+            k = key_ref[i]
+            ss = s_ref[i]
+            # Per-destination arrival counter == sorted-stream rank (the
+            # stable sort never reorders within a destination).  count is
+            # TOTAL arrivals -- incremented for every lane, sentinel nk
+            # included, exactly like the XLA chain's count.at[...].add(1).
+            kc = jnp.clip(k, 0, nk)
+            pos = count_ref[kc]
+            ok = (k >= 0) & (k < nk) & (pos < cap)
+            if rank_major:
+                cell = pos * nk + kc
+            else:
+                cell = kc * cap + pos
+            flat = jnp.where(ok, cell, nk * cap)
+            mbox_ref[flat] = jnp.where(ok, ss, -1)
+            count_ref[kc] = pos + 1
+            ovf = (k >= 0) & (k < nk) & (pos >= cap)
+            if scap is None:
+                drop_ref[0] = drop_ref[0] + ovf.astype(I32)
+            else:
+                # Spill collects overflow as (src, key) pairs in ARRIVAL
+                # order (the XLA path collects the same multiset in sorted
+                # order -- see module docstring); non-fitting lanes write
+                # -1 at the trash column scap, like the XLA form.
+                sp = scnt_ref[0]
+                fit = ovf & (sp < scap)
+                tgt = jnp.where(fit, sp, scap)
+                pr_ref[tgt] = jnp.where(fit, ss, -1)
+                pr_ref[scap + 1 + tgt] = jnp.where(fit, k, -1)
+                scnt_ref[0] = sp + fit.astype(I32)
+                drop_ref[0] = drop_ref[0] + (ovf & ~fit).astype(I32)
+            return 0
+
+        jax.lax.fori_loop(0, m, body, 0)
+
+    return kernel
+
+
+def fused_chunk_step(mbox, count, dropped, key, s, nk: int, cap: int,
+                     rank_major: bool, spill=None, interpret=None):
+    """Drop-in fused form of mailbox._compact_chunk_step: same carry
+    contract (flat mailbox incl. trash cell, total-arrivals count, drop
+    counter), same return shape.  `key` must be nk-sentineled for invalid
+    lanes, exactly like the XLA form."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    key = key.astype(I32)
+    s = s.astype(I32)
+    d1 = dropped.reshape(1)
+    if spill is None:
+        kern = _chunk_kernel(nk, cap, bool(rank_major), None)
+        mbox, count, d1 = pl.pallas_call(
+            kern,
+            out_shape=[jax.ShapeDtypeStruct(mbox.shape, mbox.dtype),
+                       jax.ShapeDtypeStruct(count.shape, count.dtype),
+                       jax.ShapeDtypeStruct(d1.shape, d1.dtype)],
+            input_output_aliases={0: 0, 1: 1, 2: 2},
+            interpret=ip,
+        )(mbox, count, d1, key, s)
+        return mbox, count, d1[0]
+    pairs, scnt = spill
+    scap = pairs.shape[1] - 1
+    pf = pairs.reshape(-1)
+    s1 = scnt.reshape(1)
+    kern = _chunk_kernel(nk, cap, bool(rank_major), scap)
+    mbox, count, d1, pf, s1 = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(mbox.shape, mbox.dtype),
+                   jax.ShapeDtypeStruct(count.shape, count.dtype),
+                   jax.ShapeDtypeStruct(d1.shape, d1.dtype),
+                   jax.ShapeDtypeStruct(pf.shape, pf.dtype),
+                   jax.ShapeDtypeStruct(s1.shape, s1.dtype)],
+        input_output_aliases={0: 0, 1: 1, 2: 2, 3: 3, 4: 4},
+        interpret=ip,
+    )(mbox, count, d1, pf, s1, key, s)
+    return mbox, count, d1[0], (pf.reshape(2, scap + 1), s1[0])
+
+
+# ---------------------------------------------------------------------------
+# Fused ring append: mailbox.ring_append's one-hot rank chain as one pass.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_kernel(dw: int, cap: int, widths):
+    """widths: per-ring trailing word width, None for flat (L,) rings."""
+    nr = len(widths)
+
+    def kernel(*refs):
+        # Inputs: cnt, drop, rings*nr, wslot, valid, payloads*nr; outputs
+        # (aliased): cnt, drop, rings*nr.
+        n_in = 4 + 2 * nr
+        wslot_ref = refs[2 + nr]
+        valid_ref = refs[2 + nr + 1]
+        pay_refs = refs[2 + nr + 2:n_in]
+        cnt_ref = refs[n_in]
+        drop_ref = refs[n_in + 1]
+        ring_refs = refs[n_in + 2:]
+        m = wslot_ref.shape[0]
+
+        def body(i, _):
+            w = wslot_ref[i]
+            v = valid_ref[i] != 0
+            wc = jnp.clip(w, 0, dw - 1)
+            pos = cnt_ref[wc]
+            ok = v & (pos < cap)
+            flat = jnp.where(ok, wc * cap + pos, dw * cap)
+            for j, ww in enumerate(widths):
+                if ww is None:
+                    val = pay_refs[j][i]
+                    ring_refs[j][flat] = jnp.where(ok, val,
+                                                   jnp.zeros_like(val))
+                else:
+                    # Whole-row write at the shared flat position: the
+                    # multi-rumor word ladder fuses for free (static
+                    # unroll; W is the packed word count, tiny).
+                    for c in range(ww):
+                        val = pay_refs[j][i, c]
+                        ring_refs[j][flat, c] = jnp.where(
+                            ok, val, jnp.zeros_like(val))
+            # ok-only increments reproduce the one-hot form: pos is
+            # monotone per slot, so once it reaches cap every later lane
+            # fails the bound under both schemes.
+            cnt_ref[wc] = pos + ok.astype(I32)
+            drop_ref[0] = drop_ref[0] + (v & ~ok).astype(I32)
+            return 0
+
+        jax.lax.fori_loop(0, m, body, 0)
+
+    return kernel
+
+
+def fused_ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
+                      cap: int, interpret=None):
+    """Drop-in fused form of mailbox.ring_append (same contract: rings /
+    payloads are aligned tuples, cnt is int32[1, dw], overflow diverts to
+    the dw*cap trash cell)."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    widths = tuple(None if p.ndim == 1 else int(p.shape[1])
+                   for p in payloads)
+    kern = _ring_kernel(dw, cap, widths)
+    cf = cnt.reshape(-1)
+    d1 = dropped.reshape(1)
+    outs = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(cf.shape, cf.dtype),
+                   jax.ShapeDtypeStruct(d1.shape, d1.dtype)]
+        + [jax.ShapeDtypeStruct(r.shape, r.dtype) for r in rings],
+        input_output_aliases={i: i for i in range(2 + len(rings))},
+        interpret=ip,
+    )(cf, d1, *rings, wslot.astype(I32), valid.astype(I32),
+      *[p for p in payloads])
+    cf, d1 = outs[0], outs[1]
+    return tuple(outs[2:]), cf.reshape(cnt.shape), d1[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused deposit: epidemic.deposit_local / deposit_rumors scatter-adds.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _deposit_kernel(b: int, n: int, width):
+    """width None: +1 count adds (deposit_local); else whole-row adds of a
+    (m, width) value matrix (deposit_rumors' broadcast newbits rows)."""
+
+    def kernel(*refs):
+        if width is None:
+            _, slot_ref, dst_ref, p_ref = refs
+        else:
+            _, slot_ref, dst_ref, val_ref, p_ref = refs
+        m = slot_ref.shape[0]
+
+        def body(i, _):
+            sl = slot_ref[i]
+            d = dst_ref[i]
+            # mode="drop" equivalence: out-of-range lanes add zero at cell
+            # 0 (a read-modify-write of an unchanged value); integer adds
+            # commute, so lane order never matters.
+            ok = (sl >= 0) & (sl < b) & (d >= 0) & (d < n)
+            idx = jnp.where(ok, sl * n + d, 0)
+            if width is None:
+                p_ref[idx] = p_ref[idx] + ok.astype(p_ref.dtype)
+            else:
+                for c in range(width):
+                    val = val_ref[i, c]
+                    p_ref[idx, c] = p_ref[idx, c] + jnp.where(
+                        ok, val, jnp.zeros_like(val))
+            return 0
+
+        jax.lax.fori_loop(0, m, body, 0)
+
+    return kernel
+
+
+def fused_deposit_add(pending, slots, dst, interpret=None):
+    """pending.at[slots, dst].add(1, mode="drop") as one fused pass;
+    `dst` already carries the caller's n sentinel for invalid lanes."""
+    if interpret is None:
+        interpret = _default_interpret()
+    b, n = int(pending.shape[0]), int(pending.shape[1])
+    kern = _deposit_kernel(b, n, None)
+    pf = pending.reshape(-1)
+    (pf,) = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(pf.shape, pf.dtype)],
+        input_output_aliases={0: 0},
+        interpret=_interpret_param(interpret),
+    )(pf, slots.astype(I32), dst.astype(I32))
+    return pf.reshape(pending.shape)
+
+
+def fused_deposit_rows(pending, slots, dst, vals, interpret=None):
+    """pending.at[slots, dst].add(vals, mode="drop") with a trailing word
+    axis: pending is (b, n, W), vals is (m, W) -- the multi-rumor deposit's
+    in-register combine."""
+    if interpret is None:
+        interpret = _default_interpret()
+    b, n, w = (int(pending.shape[0]), int(pending.shape[1]),
+               int(pending.shape[2]))
+    kern = _deposit_kernel(b, n, w)
+    pf = pending.reshape(b * n, w)
+    (pf,) = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(pf.shape, pf.dtype)],
+        input_output_aliases={0: 0},
+        interpret=_interpret_param(interpret),
+    )(pf, slots.astype(I32), dst.astype(I32), vals)
+    return pf.reshape(pending.shape)
+
+
+# ---------------------------------------------------------------------------
+# Fused unique-index scatter: event.append_messages' dual-ring write.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _unique_set_kernel(widths):
+    nr = len(widths)
+
+    def kernel(*refs):
+        flat_ref = refs[nr]
+        val_refs = refs[nr + 1:nr + 1 + nr]
+        ring_refs = refs[nr + 1 + nr:]
+        m = flat_ref.shape[0]
+
+        def body(i, _):
+            f = flat_ref[i]
+            for j, ww in enumerate(widths):
+                if ww is None:
+                    ring_refs[j][f] = val_refs[j][i]
+                else:
+                    for c in range(ww):
+                        ring_refs[j][f, c] = val_refs[j][i, c]
+            return 0
+
+        jax.lax.fori_loop(0, m, body, 0)
+
+    return kernel
+
+
+def fused_unique_set(rings, flat, vals, interpret=None):
+    """ring.at[flat].set(vals, unique_indices=True) over aligned ring/value
+    tuples in ONE pass (the append path's id ring and word ring share their
+    reservation positions).  Indices must be unique and in bounds -- the
+    caller's per-lane trash-slot construction guarantees both -- so the
+    serial write order is immaterial and the result is bit-identical to the
+    XLA scatters."""
+    if interpret is None:
+        interpret = _default_interpret()
+    widths = tuple(None if v.ndim == 1 else int(v.shape[1]) for v in vals)
+    kern = _unique_set_kernel(widths)
+    nr = len(rings)
+    outs = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(r.shape, r.dtype) for r in rings],
+        input_output_aliases={i: i for i in range(nr)},
+        interpret=_interpret_param(interpret),
+    )(*rings, flat.astype(I32), *vals)
+    return tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# Capability probes (PR-4 pattern, split per satellite 1: interpret-mode
+# availability is a different question from TPU lowering).
+# ---------------------------------------------------------------------------
+
+
+def _probe_case(interpret: bool) -> str:
+    """Run a tiny fused chunk step + ring append and compare against the
+    XLA forms; returns '' on bit-identical results, else a named reason.
+
+    The probe compares CONCRETE outputs, but its (lru_cached) callers can
+    fire mid-trace -- Config.deliver_kernel_resolved is read inside
+    shard_map/jit closures that only exist at trace time.  JAX trace
+    contexts are thread-local, so running the probe body on a fresh thread
+    escapes any ambient trace and keeps the comparisons eager; the result
+    is a host string, which is trace-safe to branch on."""
+    import threading
+
+    out: list = []
+
+    def run():
+        try:
+            out.append(_probe_case_impl(interpret))
+        except Exception as e:  # noqa: BLE001 - reported as the reason
+            out.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    return out[0]
+
+
+def _probe_case_impl(interpret: bool) -> str:
+    from gossip_simulator_tpu.ops import mailbox as mb
+
+    nk, cap = 5, 2
+    key = jnp.array([0, 3, 0, 0, nk, 2, 3, 3, 3, 1], I32)
+    s = jnp.arange(10, dtype=I32) + 100
+    init = lambda: (jnp.full((nk * cap + 1,), -1, I32),
+                    jnp.zeros((nk + 1,), I32), jnp.zeros((), I32))
+    fm, fc, fd = fused_chunk_step(*init(), key, s, nk, cap, False,
+                                  interpret=interpret)
+    xm, xc, xd = mb._compact_chunk_step(*init(), key, s, nk, cap, False)
+    if not (bool((fm == xm).all()) and bool((fc == xc).all())
+            and int(fd) == int(xd)):
+        return "fused chunk step diverged from the XLA reference"
+
+    dw, rcap = 3, 2
+    rings = (jnp.zeros((dw * rcap + 1,), I32),
+             jnp.zeros((dw * rcap + 1, 2), jnp.uint32))
+    cnt = jnp.zeros((1, dw), I32)
+    pay = (jnp.arange(7, dtype=I32) + 1,
+           jnp.arange(14, dtype=jnp.uint32).reshape(7, 2) + 1)
+    wslot = jnp.array([0, 1, 0, 2, 0, 1, 0], I32)
+    valid = jnp.array([1, 1, 1, 0, 1, 1, 1], bool)
+    fr, fcn, fdr = fused_ring_append(rings, cnt, jnp.zeros((), I32), pay,
+                                     wslot, valid, dw, rcap,
+                                     interpret=interpret)
+    xr, xcn, xdr = mb.ring_append(rings, cnt, jnp.zeros((), I32), pay,
+                                  wslot, valid, dw, rcap)
+    if not (all(bool((a == b).all()) for a, b in zip(fr, xr))
+            and bool((fcn == xcn).all()) and int(fdr) == int(xdr)):
+        return "fused ring append diverged from the XLA reference"
+    return ""
+
+
+@functools.lru_cache(maxsize=1)
+def interpret_unsupported() -> str:
+    """'' when the fused kernels run (and match XLA) in interpret mode on
+    this jax build; else the reason.  This is the CPU-CI gate: interpret
+    mode needs no TPU, so a non-empty value means the jax build itself
+    cannot trace these kernels."""
+    try:
+        return _probe_case(interpret=True)
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        return f"{type(e).__name__}: {e}"
+
+
+@functools.lru_cache(maxsize=1)
+def tpu_unsupported() -> str:
+    """'' when the fused kernels lower AND pass on-device parity on a real
+    TPU backend; else the named reason (used by the auto gate policy)."""
+    if jax.default_backend() != "tpu":
+        return f"no TPU backend (jax.default_backend()={jax.default_backend()!r})"
+    try:
+        return _probe_case(interpret=False)
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        return f"{type(e).__name__}: {e}"
+
+
+def kernel_unavailable_reason() -> str:
+    """'' when `-deliver-kernel pallas` can run on THIS host (natively on
+    TPU, interpret mode elsewhere); else the named reason."""
+    if jax.default_backend() == "tpu":
+        return tpu_unsupported()
+    return interpret_unsupported()

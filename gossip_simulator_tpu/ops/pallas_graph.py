@@ -18,11 +18,15 @@ Properties:
   statistics).  Self-collisions get the reference's (id+1) % n patch
   (simulator.go:98-100).
 
-Off-TPU, interpret=True runs under pltpu.InterpretParams for STRUCTURAL
-checks only: the interpreter's prng_random_bits is an all-zero stub, so the
-"graph" degenerates to everyone-befriends-node-0.  models/graphs.py therefore
-routes to this kernel only on a real TPU backend; never validate
-distributional properties in interpret mode.
+Off-TPU, interpret=True runs the kernels in pallas interpret mode for
+STRUCTURAL checks only: the TPU PRNG is replaced by an explicit all-zero
+stub (jax 0.4.37's interpreter raises NotImplementedError on
+pltpu.prng_random_bits, so the stub is ours, statically selected), and the
+"graph" degenerates to everyone-befriends-node-0.  models/graphs.py
+therefore routes to this kernel only on a real TPU backend; never validate
+distributional properties in interpret mode.  The interpret argument to
+pallas_call goes through ops.pallas_deliver._interpret_param, which papers
+over the pltpu.InterpretParams availability drift across jax versions.
 """
 
 from __future__ import annotations
@@ -34,21 +38,29 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gossip_simulator_tpu.ops.pallas_deliver import _interpret_param
+
 BLOCK_ROWS = 512
 LANES = 128  # minimum last-dim tile; k columns are sliced out afterwards
 
 
-def _kout_kernel(n: int, k: int, row0: int, seed_ref, out_ref):
+def _kout_kernel(n: int, k: int, row0: int, interpret: bool, seed_ref,
+                 out_ref):
     blk = pl.program_id(0)
-    # Seed by GLOBAL block index so a row0>0 slice reproduces exactly the
-    # same rows as the corresponding blocks of a full generation.
-    pltpu.prng_seed(seed_ref[0], row0 // BLOCK_ROWS + blk)
     # The output is TRANSPOSED (k, rows): a (rows, k) pallas output gets the
     # forced T(8,128) tiled layout, padding k<=6 lanes out to 128 -- 51 GB
     # of HBM at rows=1e8.  With rows on the lane axis the padding is only
     # k -> 8 sublanes; the caller transposes back to the natural compact
     # (rows, k) on the XLA side.
-    bits = pltpu.prng_random_bits((k, BLOCK_ROWS))
+    if interpret:
+        # The interpreter has no TPU PRNG (NotImplementedError on 0.4.37):
+        # keep the documented all-zero-stub semantics explicitly.
+        bits = jnp.zeros((k, BLOCK_ROWS), jnp.int32)
+    else:
+        # Seed by GLOBAL block index so a row0>0 slice reproduces exactly
+        # the same rows as the corresponding blocks of a full generation.
+        pltpu.prng_seed(seed_ref[0], row0 // BLOCK_ROWS + blk)
+        bits = pltpu.prng_random_bits((k, BLOCK_ROWS))
     peers = (bits.astype(jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
     gid = (row0 + blk * BLOCK_ROWS
            + jax.lax.broadcasted_iota(jnp.int32, (k, BLOCK_ROWS), 1))
@@ -58,13 +70,17 @@ def _kout_kernel(n: int, k: int, row0: int, seed_ref, out_ref):
 _ER_STREAM = 0x4552D14D  # XOR'd into the seed: decorrelates ER from kout
 
 
-def _erdos_kernel(n: int, lam: float, cap: int, row0: int, seed_ref,
-                  out_ref):
+def _erdos_kernel(n: int, lam: float, cap: int, row0: int, interpret: bool,
+                  seed_ref, out_ref):
     blk = pl.program_id(0)
-    # The platform caps prng_seed at 2 values, so the stream tag folds into
-    # the seed word instead of riding as a third argument.
-    pltpu.prng_seed(seed_ref[0] ^ _ER_STREAM, row0 // BLOCK_ROWS + blk)
-    bits = pltpu.prng_random_bits((cap + 1, BLOCK_ROWS))
+    if interpret:
+        # Same zero-bit stub as _kout_kernel: degree 0 everywhere.
+        bits = jnp.zeros((cap + 1, BLOCK_ROWS), jnp.int32)
+    else:
+        # The platform caps prng_seed at 2 values, so the stream tag folds
+        # into the seed word instead of riding as a third argument.
+        pltpu.prng_seed(seed_ref[0] ^ _ER_STREAM, row0 // BLOCK_ROWS + blk)
+        bits = pltpu.prng_random_bits((cap + 1, BLOCK_ROWS))
     # Row 0 -> the Poisson uniform; rows 1.. -> peer picks.  The top 24 bits
     # shift into int32 range first (Mosaic has no uint32->f32 cast).
     u = (bits[0:1].astype(jnp.uint32) >> jnp.uint32(8)).astype(
@@ -114,14 +130,14 @@ def erdos_pallas(n: int, lam: float, row0: int, rows: int, seed,
     nblocks = -(-rows // BLOCK_ROWS)
     seed_arr = jnp.asarray(seed, dtype=jnp.int32).reshape((1,))
     out = pl.pallas_call(
-        functools.partial(_erdos_kernel, n, lam, cap, row0),
+        functools.partial(_erdos_kernel, n, lam, cap, row0, interpret),
         grid=(nblocks,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=pl.BlockSpec((cap + 1, BLOCK_ROWS), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((cap + 1, nblocks * BLOCK_ROWS),
                                        jnp.int32),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_interpret_param(interpret),
     )(seed_arr)
     deg = jnp.minimum(out[0, :rows], cap)
     slot = jnp.arange(cap, dtype=jnp.int32)[:, None]
@@ -144,13 +160,13 @@ def kout_pallas(n: int, k: int, row0: int, rows: int, seed,
     nblocks = -(-rows // BLOCK_ROWS)
     seed_arr = jnp.asarray(seed, dtype=jnp.int32).reshape((1,))
     out = pl.pallas_call(
-        functools.partial(_kout_kernel, n, k, row0),
+        functools.partial(_kout_kernel, n, k, row0, interpret),
         grid=(nblocks,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=pl.BlockSpec((k, BLOCK_ROWS), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((k, nblocks * BLOCK_ROWS),
                                        jnp.int32),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_interpret_param(interpret),
     )(seed_arr)
     return out[:, :rows].T

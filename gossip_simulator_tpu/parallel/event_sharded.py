@@ -134,13 +134,15 @@ def _ring_append(cfg: Config, n_local: int, mail, cnt, dropped, payload,
 
     dw = event.ring_windows(cfg)
     cap = (mail.shape[0] - event.ring_tail(cfg, n_local)) // dw
+    dkern = cfg.deliver_kernel_resolved
     if words is not None:
         (mail, mail_words), cnt, dropped = ring_append(
             (mail, mail_words), cnt, dropped, (payload, words), wslot,
-            valid, dw, cap)
+            valid, dw, cap, kernel=dkern)
         return mail, cnt, dropped, mail_words
     (mail,), cnt, dropped = ring_append(
-        (mail,), cnt, dropped, (payload,), wslot, valid, dw, cap)
+        (mail,), cnt, dropped, (payload,), wslot, valid, dw, cap,
+        kernel=dkern)
     return mail, cnt, dropped
 
 

@@ -67,7 +67,8 @@ def _route_append(cfg, n_local, s, ring, dst_g, pay, wslot, valid, rcap):
     (ring_dst, ring_pay), ring_cnt, dropped = ring_append(
         (ring_dst, ring_pay), ring_cnt, dropped + ovf,
         (jnp.where(rvalid, rd, 0), jnp.where(rvalid, rp, 0)),
-        jnp.where(rvalid, rw, 0), rvalid, dw, cap)
+        jnp.where(rvalid, rw, 0), rvalid, dw, cap,
+        kernel=cfg.deliver_kernel_resolved)
     return ring_dst, ring_pay, ring_cnt, dropped
 
 

@@ -94,7 +94,8 @@ def _deposit_routed(cfg: Config, n_local: int, n_shards: int, pending,
                                         n_shards, cap)
     rvalid = recv >= 0
     rdst, rslot = exchange.unpack_dst_slot(jnp.maximum(recv, 0), d)
-    pending = epidemic.deposit_local(pending, rdst, rslot, rvalid)
+    pending = epidemic.deposit_local(pending, rdst, rslot, rvalid,
+                                     kernel=cfg.deliver_kernel_resolved)
     return pending, overflow
 
 
@@ -343,7 +344,8 @@ def make_sharded_heal(cfg: Config, mesh):
         # shard-local, so they skip the route.
         pdst = jnp.broadcast_to(rows[:, None], (n_local, k)).reshape(-1)
         pending = epidemic.deposit_local(pending, pdst, slots,
-                                         pull.reshape(-1))
+                                         pull.reshape(-1),
+                                         kernel=cfg.deliver_kernel_resolved)
         rep, blk, ovf = jax.lax.psum(
             (rep, jnp.asarray(blk, I32), ovf), AXIS)
         return st._replace(
@@ -444,7 +446,8 @@ def make_sharded_overlay_round(cfg: Config, mesh):
             dest, valid, s, route_cap)
         rvalid = rsrc >= 0
         mbox, _, dropped = deliver(rsrc, jnp.where(rvalid, rdst, 0), rvalid,
-                                   n_local, mbox_cap)
+                                   n_local, mbox_cap,
+                                   kernel=cfg.deliver_kernel_resolved)
         return mbox, dropped + ovf
 
     def ids_fn():

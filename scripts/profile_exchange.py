@@ -130,6 +130,49 @@ def profile_append_s1(m: int, iters: int) -> dict:
     }
 
 
+def profile_fused_append(m: int, iters: int) -> dict:
+    """-deliver-kernel A/B for the mail-ring append (ISSUE 9): one
+    emission batch through mailbox.ring_append with kernel="xla" (one-hot
+    rank chain) vs "pallas" (ops/pallas_deliver.fused_ring_append),
+    matched inputs, ns/lane both ways.  `mode` is "tpu" for native
+    lowering or "interpret" on CPU, where the fused form is the serial
+    reference pass -- lanes are capped there (O(m) at ~us/lane; a
+    correctness surface, not a hardware estimate).  Hosts whose jax build
+    cannot run the kernels record the probe's named reason."""
+    from gossip_simulator_tpu.ops import pallas_deliver as pd
+
+    why = pd.kernel_unavailable_reason()
+    if why:
+        return {"skipped": why}
+    mode = "tpu" if jax.default_backend() == "tpu" else "interpret"
+    m_eff = min(m, 8192) if mode == "interpret" else m
+    cap = m_eff
+    rng = np.random.default_rng(0)
+    ring = np.zeros((DW * cap + m_eff,), np.int32)
+    cnt = np.zeros((1, DW), np.int32)
+    pay = rng.integers(0, 1 << 20, (m_eff,), dtype=np.int32)
+    wslot = rng.integers(0, DW, (m_eff,), dtype=np.int32)
+    valid = rng.random((m_eff,)) < 0.9
+
+    def make(kernel):
+        @jax.jit
+        def f(ring, cnt, pay, wslot, valid):
+            return ring_append((ring,), cnt, jnp.zeros((), jnp.int32),
+                               (pay,), wslot, valid, DW, cap,
+                               kernel=kernel)
+        return f
+
+    args = (ring, cnt, pay, wslot, valid)
+    t_x = _timeit(make("xla"), args, iters)
+    t_p = _timeit(make("pallas"), args, iters)
+    return {
+        "mode": mode, "m": m_eff,
+        "xla_s": t_x, "xla_ns_per_lane": t_x * 1e9 / m_eff,
+        "pallas_s": t_p, "pallas_ns_per_lane": t_p * 1e9 / m_eff,
+        "speedup_x": t_x / t_p,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--m", type=int, default=None,
@@ -154,6 +197,8 @@ def main() -> int:
     a = profile_append_s1(m, args.iters)
     a["ns_per_lane"] = {k[:-2]: v * 1e9 / m for k, v in a.items()}
     rec["rows"]["append_s1"] = a
+
+    rec["rows"]["fused_kernel"] = profile_fused_append(m, args.iters)
 
     if s > 1:
         zl = m  # zero-loss per-pair cap (a batch cannot exceed its lanes)

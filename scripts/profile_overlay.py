@@ -100,6 +100,52 @@ def profile_chunk_floor(n: int, cap: int, widths, iters: int) -> dict:
     return rows
 
 
+def profile_fused_kernel(n: int, cap: int, widths, iters: int) -> dict:
+    """-deliver-kernel A/B floor (ISSUE 9): ONE delivery chunk step at each
+    ladder width -- the XLA sort + segment-rank + scatter chain vs the
+    fused pallas kernel (ops/pallas_deliver.fused_chunk_step), matched
+    inputs, ns/lane both ways.  `mode` is "tpu" when the kernels lower
+    natively (the real perf row) or "interpret" on CPU, where the fused
+    form is a SERIAL reference pass -- a correctness surface whose ns/lane
+    is not a hardware estimate, so interpret rows cap the width (the loop
+    is O(width) at ~us/lane).  Hosts whose jax build cannot run the
+    kernels record the probe's named reason instead of rows."""
+    from gossip_simulator_tpu.ops import mailbox as mbx
+    from gossip_simulator_tpu.ops import pallas_deliver as pd
+
+    why = pd.kernel_unavailable_reason()
+    if why:
+        return {"skipped": why}
+    mode = "tpu" if jax.default_backend() == "tpu" else "interpret"
+    rng = np.random.default_rng(0)
+    rows = {"mode": mode}
+
+    def make(kernel):
+        @jax.jit
+        def f(key, src):
+            return mbx._compact_chunk_step(
+                jnp.full((n * cap + 1,), -1, jnp.int32),
+                jnp.zeros((n + 1,), jnp.int32), jnp.zeros((), jnp.int32),
+                key, src, n, cap, False, kernel=kernel)
+        return f
+
+    fx, fp = make("xla"), make("pallas")
+    for w in widths:
+        w = min(w, 8192) if mode == "interpret" else w
+        if str(w) in rows:
+            continue
+        key = jnp.asarray(rng.integers(0, n + 1, w).astype(np.int32))
+        src = jnp.asarray(rng.integers(0, n, w, dtype=np.int32))
+        t_x = _timeit(lambda: fx(key, src), iters)
+        t_p = _timeit(lambda: fp(key, src), iters)
+        rows[str(w)] = {
+            "xla_s_per_chunk": t_x, "xla_ns_per_lane": t_x * 1e9 / w,
+            "pallas_s_per_chunk": t_p, "pallas_ns_per_lane": t_p * 1e9 / w,
+            "speedup_x": t_x / t_p,
+        }
+    return rows
+
+
 def profile_row_floor(n: int, cap: int, iters: int) -> dict:
     """Per-ROW fixed costs the round-7 gates remove: the zero-row
     popcount (dead-row skip) and the eager (cap, n) emission-mask
@@ -186,6 +232,8 @@ def main() -> int:
            "iters": args.iters, "rows": {}}
     rec["rows"]["chunk_floor"] = profile_chunk_floor(n, cap, widths,
                                                      args.iters)
+    rec["rows"]["fused_kernel"] = profile_fused_kernel(n, cap, widths,
+                                                       args.iters)
     rec["rows"]["row_floor"] = profile_row_floor(n, cap, args.iters)
     if not args.skip_rounds:
         rn = args.rounds_n or max(65_536, n // 8)

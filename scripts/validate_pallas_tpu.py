@@ -1,12 +1,14 @@
 #!/usr/bin/env python
-"""TPU-side distributional validation of the Pallas graph generators.
+"""TPU-side validation of the Pallas kernels (graph generators + the
+fused delivery kernel), recorded as an artifact (PALLAS_VALIDATION.json
+at the repo root).  bench.py runs this automatically during a TPU bench
+pass.
 
 tests/test_pallas_graph.py can only check structure off-TPU (the interpret
 mode PRNG is an all-zero stub -- see ops/pallas_graph.py's own warning), so
 the statistical properties the simulation leans on -- destination
 uniformity, Poisson degrees, seed decorrelation -- are validated HERE on
-real hardware and recorded as an artifact (PALLAS_VALIDATION.json at the
-repo root).  bench.py runs this automatically during a TPU bench pass.
+real hardware.
 
 Checks (all on freshly generated tables):
 * kout: chi-square destination uniformity over 256 buckets (statistic
@@ -15,9 +17,18 @@ Checks (all on freshly generated tables):
 * erdos: degree mean/var against Poisson(lam), chi-square of the degree
   histogram against the Poisson pmf (tail merged), destination uniformity,
   no self loops in live slots.
+* deliver (ISSUE 9, run_deliver_checks): the -deliver-kernel fused forms
+  (ops/pallas_deliver) bit-identical to the XLA chains they replace --
+  chunk step both layouts, spill counts + pair multiset, ring append,
+  deliver/deliver_pair gates, deposits, unique-set dual ring.  These are
+  PRNG-free, so they also run in interpret mode on CPU hosts
+  (--interpret), where the dated verdict is MERGED into the existing
+  artifact without disturbing recorded TPU results.
 
 Run: python scripts/validate_pallas_tpu.py [--out PALLAS_VALIDATION.json]
-Exit 0 iff every check passes (also exits 3 when no TPU is present).
+     python scripts/validate_pallas_tpu.py --interpret   # CPU deliver-only
+Exit 0 iff every check passes (also exits 3 when no TPU is present and
+--interpret was not given).
 """
 
 from __future__ import annotations
@@ -133,21 +144,169 @@ def run_checks() -> dict:
     }
 
 
+def run_deliver_checks() -> dict:
+    """Bit-identity of every fused delivery form against the XLA chain it
+    replaces (ops/pallas_deliver vs ops/mailbox + models/epidemic).  No
+    PRNG inside the kernels, so the same assertions hold natively on TPU
+    and in interpret mode on CPU; `mode` records which one ran.  Hosts
+    whose jax build cannot run the kernels record the probe's named
+    reason instead of checks (never a crash)."""
+    import jax.numpy as jnp
+
+    from gossip_simulator_tpu.models import epidemic
+    from gossip_simulator_tpu.ops import mailbox as mb
+    from gossip_simulator_tpu.ops import pallas_deliver as pd
+
+    mode = "tpu" if jax.default_backend() == "tpu" else "interpret"
+    why = pd.kernel_unavailable_reason()
+    if why:
+        return {"mode": mode, "skipped": why}
+    I32 = jnp.int32
+    checks = []
+
+    def add(name, ok, **detail):
+        checks.append({"name": name, "ok": bool(ok), **detail})
+
+    def eq(*pairs):
+        return all(bool((jnp.asarray(a) == jnp.asarray(b)).all())
+                   for a, b in pairs)
+
+    def init(nk, cap):
+        return (jnp.full((nk * cap + 1,), -1, I32),
+                jnp.zeros((nk + 1,), I32), jnp.zeros((), I32))
+
+    rng = np.random.default_rng(0)
+    nk, cap, m = 7, 3, 64
+    key = jnp.asarray(rng.integers(0, nk + 1, m), I32)
+    s = jnp.asarray(rng.integers(0, 1000, m), I32)
+    for rank_major in (False, True):
+        f = pd.fused_chunk_step(*init(nk, cap), key, s, nk, cap, rank_major)
+        x = mb._compact_chunk_step(*init(nk, cap), key, s, nk, cap,
+                                   rank_major)
+        add(f"chunk_step_rank_major_{rank_major}", eq(*zip(f, x)))
+
+    sp = lambda: (jnp.full((2, m + 1), -1, I32), jnp.zeros((), I32))
+    fm, fc, fd, (fp, fs) = pd.fused_chunk_step(
+        *init(nk, cap), key, s, nk, cap, False, spill=sp())
+    xm, xc, xd, (xp, xs) = mb._compact_chunk_step(
+        *init(nk, cap), key, s, nk, cap, False, spill=sp())
+    add("chunk_step_spill_counts",
+        eq((fm, xm), (fc, xc), (fd, xd), (fs, xs)))
+    fpn, xpn = np.asarray(fp), np.asarray(xp)
+    add("chunk_step_spill_pair_multiset",
+        sorted(map(tuple, fpn[:, :int(fs)].T))
+        == sorted(map(tuple, xpn[:, :int(xs)].T)),
+        note="order divergence is documented; the multiset must match")
+
+    dw, rcap, W = 3, 4, 2
+    rings = (jnp.zeros((dw * rcap + 1,), I32),
+             jnp.zeros((dw * rcap + 1, W), jnp.uint32))
+    pay = (jnp.asarray(rng.integers(1, 100, m), I32),
+           jnp.asarray(rng.integers(1, 100, (m, W)), np.uint32))
+    cnt = jnp.asarray(rng.integers(0, 2, (1, dw)), I32)
+    wslot = jnp.asarray(rng.integers(0, dw, m), I32)
+    valid = jnp.asarray(rng.random(m) < 0.8)
+    fr, fcn, frd = pd.fused_ring_append(rings, cnt, jnp.zeros((), I32),
+                                        pay, wslot, valid, dw, rcap)
+    xr, xcn, xrd = mb.ring_append(rings, cnt, jnp.zeros((), I32), pay,
+                                  wslot, valid, dw, rcap)
+    add("ring_append_dual", eq(*zip(fr, xr), (fcn, xcn), (frd, xrd)))
+
+    n = 11
+    src = jnp.asarray(rng.integers(0, n, m), I32)
+    dst = jnp.asarray(rng.integers(0, n, m), I32)
+    dvalid = jnp.asarray(rng.random(m) < 0.8)
+    for compact in (None, 16):
+        f = mb.deliver(src, dst, dvalid, n, cap, compact_chunk=compact,
+                       kernel="pallas")
+        x = mb.deliver(src, dst, dvalid, n, cap, compact_chunk=compact,
+                       kernel="xla")
+        add(f"deliver_gate_compact_{compact}", eq(*zip(f, x)))
+    typ = jnp.asarray(rng.integers(0, 2, m), I32)
+    f = mb.deliver_pair(src, dst, typ, dvalid, n, cap, kernel="pallas")
+    x = mb.deliver_pair(src, dst, typ, dvalid, n, cap, kernel="xla")
+    add("deliver_pair_gate", eq(*zip(f, x)))
+
+    B, k, Wr = 4, 5, 3
+    md = n * k
+    pending = jnp.asarray(rng.integers(0, 3, (B, n)), I32)
+    slots = jnp.asarray(rng.integers(0, B, md), I32)
+    dvalid = jnp.asarray(rng.random(md) < 0.7)
+    ddst = jnp.asarray(rng.integers(0, n, md), I32)
+    add("deposit_local",
+        eq((epidemic.deposit_local(pending, ddst, slots, dvalid,
+                                   kernel="pallas"),
+            epidemic.deposit_local(pending, ddst, slots, dvalid,
+                                   kernel="xla"))))
+    pr = jnp.asarray(rng.integers(0, 3, (B, n, Wr)), I32)
+    newbits = jnp.asarray(rng.random((n, Wr)) < 0.5)
+    add("deposit_rumors",
+        eq((epidemic.deposit_rumors(pr, ddst, slots, dvalid, newbits,
+                                    kernel="pallas"),
+            epidemic.deposit_rumors(pr, ddst, slots, dvalid, newbits,
+                                    kernel="xla"))))
+
+    L, mu = 40, 12
+    ids = jnp.asarray(rng.integers(0, 9, L), I32)
+    words = jnp.asarray(rng.integers(0, 9, (L, W)), np.uint32)
+    flat = jnp.asarray(rng.permutation(L)[:mu], I32)
+    iv = jnp.asarray(rng.integers(0, 99, mu), I32)
+    wv = jnp.asarray(rng.integers(0, 99, (mu, W)), np.uint32)
+    fi, fw = pd.fused_unique_set((ids, words), flat, (iv, wv))
+    add("unique_set_dual",
+        eq((fi, ids.at[flat].set(iv, unique_indices=True)),
+           (fw, words.at[flat].set(wv, unique_indices=True))))
+
+    return {
+        "mode": mode,
+        "device": jax.devices()[0].device_kind,
+        "checks": checks,
+        "all_pass": all(c["ok"] for c in checks),
+    }
+
+
+def _merge_out(path: str, updates: dict) -> dict:
+    """Merge `updates` into the JSON artifact at `path` (preserving any
+    recorded sections -- e.g. the CPU --interpret verdict must not erase
+    the TPU graph checks, and vice versa)."""
+    data = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            data = {}
+    data.update(updates)
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=1)
+    return data
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "PALLAS_VALIDATION.json"))
+    ap.add_argument("--interpret", action="store_true",
+                    help="run only the (PRNG-free) delivery-kernel checks "
+                         "in interpret mode -- valid on CPU hosts; the "
+                         "verdict is merged into --out")
     args = ap.parse_args()
+    if args.interpret:
+        result = run_deliver_checks()
+        _merge_out(args.out, {"deliver_interpret": result})
+        print(json.dumps(result))
+        return 0 if result.get("all_pass") else 1
     if jax.default_backend() != "tpu":
         print(json.dumps({"skipped": "no TPU present; interpret-mode PRNG "
-                                     "validates nothing"}))
+                                     "validates nothing (use --interpret "
+                                     "for the PRNG-free deliver checks)"}))
         return 3
     result = run_checks()
-    with open(args.out, "w") as fh:
-        json.dump(result, fh, indent=1)
-    print(json.dumps(result))
-    return 0 if result["all_pass"] else 1
+    deliver = run_deliver_checks()
+    _merge_out(args.out, {**result, "deliver_tpu": deliver})
+    print(json.dumps({**result, "deliver_tpu": deliver}))
+    return 0 if (result["all_pass"] and deliver.get("all_pass")) else 1
 
 
 if __name__ == "__main__":

@@ -1,7 +1,7 @@
-"""Pallas kout generator.  CPU runs under pltpu.InterpretParams, whose PRNG
-is a deterministic stub (all-zero bits) -- so off-TPU these tests are
-structural (shape / range / self-patch / shard alignment), and the
-distributional check self-skips unless a real TPU is present.
+"""Pallas kout generator.  CPU runs in pallas interpret mode, where the
+kernels substitute a deterministic all-zero-bit PRNG stub -- so off-TPU
+these tests are structural (shape / range / self-patch / shard alignment),
+and the distributional check self-skips unless a real TPU is present.
 
 Capability guard: pallas interpret mode is an UNSTABLE jax surface --
 hosts whose jax build has drifted (e.g. a pltpu API rename) raise
