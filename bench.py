@@ -38,6 +38,36 @@ import numpy as np  # noqa: E402
 from gossip_simulator_tpu.backends.jax_backend import JaxStepper  # noqa: E402
 from gossip_simulator_tpu.backends.native import NativeStepper  # noqa: E402
 from gossip_simulator_tpu.config import Config  # noqa: E402
+from gossip_simulator_tpu.utils import trace as _trace  # noqa: E402
+from gossip_simulator_tpu.utils.telemetry import GCOL  # noqa: E402
+
+# --- flight recorder (PR 10) -------------------------------------------------
+# With `--run-dir DIR`, every measured row writes a self-describing artifact
+# (utils/artifact.py layout) under DIR/<row-name>/, and the whole bench run
+# records one span per row into DIR/bench_trace.json.  The row name flows
+# through pool_retry's `name=` (every hardware capture goes through it) or
+# the suite loops' explicit set -- `_row_name` is the single channel so
+# `_bench_backend` needs no signature change at any call site.
+_RUN_DIR_ROOT: str | None = None
+_ROW_NAME: str = ""
+
+
+class _named_row:
+    """Scoped bench-row name for artifact/trace attribution."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        global _ROW_NAME
+        self._prev = _ROW_NAME
+        _ROW_NAME = self.name
+        return self
+
+    def __exit__(self, *exc):
+        global _ROW_NAME
+        _ROW_NAME = self._prev
+        return False
 
 # Error signatures of an unreachable/flaky accelerator pool (seen as
 # grpc/PJRT faults when the axon TPU workers are down -- hit in the PR-2
@@ -58,31 +88,114 @@ def pool_retry(fn, *args, name: str = "", retries: int = 3,
                base_delay_s: float = 10.0, _sleep=time.sleep, **kw):
     """Run `fn`, retrying pool-shaped failures (is_pool_error) up to
     `retries` times with exponential backoff.  A still-failing call -- or
-    a non-pool error -- returns a dated ``skipped`` record instead of
-    raising, so one dead pool stops ONE row, not the whole suite (the
-    PR-2/PR-3 sessions each lost their TPU evidence window to an
-    unreachable pool killing bench.py mid-record).  `_sleep` is
-    injectable for the unit test."""
+    a non-pool error -- returns a dated ``skipped`` record (skip_record,
+    THE one emitter of them) instead of raising, so one dead pool stops
+    ONE row, not the whole suite (the PR-2/PR-3 sessions each lost their
+    TPU evidence window to an unreachable pool killing bench.py
+    mid-record).  `_sleep` is injectable for the unit test."""
+    last = None
+    with _named_row(name or getattr(fn, "__name__", "call")):
+        for attempt in range(retries + 1):
+            try:
+                return fn(*args, **kw)
+            except Exception as e:  # noqa: BLE001 -- recorded, not silent
+                last = e
+                if not is_pool_error(e) or attempt == retries:
+                    break
+                delay = base_delay_s * (2 ** attempt)
+                print(f"[bench] {name or getattr(fn, '__name__', 'call')}: "
+                      f"pool error (attempt {attempt + 1}/{retries + 1}), "
+                      f"retrying in {delay:.0f}s: {e!r}", file=sys.stderr)
+                _sleep(delay)
+    return skip_record(last, attempts=attempt + 1)
+
+
+def skip_record(error: BaseException, attempts: int = 1) -> dict:
+    """THE dated skip record (satellite: one helper instead of per-round
+    hand-written JSON notes).  Every queued hardware row carries exactly
+    this shape; QUEUED_HARDWARE_ROWS + queued_section() aggregate them
+    into one generated list."""
     import datetime
 
-    last = None
-    for attempt in range(retries + 1):
-        try:
-            return fn(*args, **kw)
-        except Exception as e:  # noqa: BLE001 -- recorded, never silent
-            last = e
-            if not is_pool_error(e) or attempt == retries:
-                break
-            delay = base_delay_s * (2 ** attempt)
-            print(f"[bench] {name or getattr(fn, '__name__', 'call')}: "
-                  f"pool error (attempt {attempt + 1}/{retries + 1}), "
-                  f"retrying in {delay:.0f}s: {e!r}", file=sys.stderr)
-            _sleep(delay)
     return {"skipped": True,
             "date": datetime.date.today().isoformat(),
-            "error": repr(last),
-            "pool_error": is_pool_error(last),
-            "attempts": attempt + 1}
+            "error": repr(error),
+            "pool_error": is_pool_error(error),
+            "attempts": attempts}
+
+
+# Every bench row that NEEDS a TPU and is still unmeasured (the pool has
+# been unreachable for sessions r6-r9; the dated skip records are scattered
+# across BENCH_SELF_r06..r09.json).  One place, one shape: the generated
+# QUEUED section in the README renders from this table, and the next
+# hardware window works it top to bottom.
+QUEUED_HARDWARE_ROWS = (
+    {"row": "sharded_50m_twins", "queued_since": "r6",
+     "capture": "capture_sharded_1chip + capture_scale50",
+     "what": "50M sharded-vs-jax same-seed twins on a v5e (PR-2 routing "
+             "claims rest on CPU stand-ins)"},
+    {"row": "exchange_profile", "queued_since": "r6",
+     "capture": "capture_exchange_profile",
+     "what": "all_to_all exchange cost split at S=8"},
+    {"row": "two_phase_100m", "queued_since": "r7",
+     "capture": "capture_100m_two_phase",
+     "what": "100M reference-default two-phase wall clock (PR-3 overlay "
+             "floors measured on CPU only)"},
+    {"row": "overlay_profile", "queued_since": "r7",
+     "capture": "capture_overlay_profile",
+     "what": "phase-1 chunk-ladder / dead-skip gate timings at scale"},
+    {"row": "multirumor_50m", "queued_since": "r8",
+     "capture": "capture_multirumor_50m",
+     "what": "50M single- vs multi-rumor twins (marginal cost of the "
+             "rumor axis at scale)"},
+    {"row": "deliver_kernel_twins", "queued_since": "r9",
+     "capture": "capture_deliver_kernel_twins",
+     "what": "50M/100M xla-vs-pallas same-seed wall-clock twins "
+             "(kernel is parity-pinned but unmeasured)"},
+    {"row": "pallas_validation", "queued_since": "r6",
+     "capture": "_pallas_validation",
+     "what": "on-device distributional checks + fused_kernel profile "
+             "rows (interpret-mode CPU rows are correctness-only)"},
+)
+
+
+def queued_section() -> str:
+    """The generated QUEUED markdown block (README carries it between
+    QUEUED:BEGIN/END markers; regenerate with `python bench.py
+    --write-queued`)."""
+    lines = [
+        "All rows below need TPU hardware and carry dated `skipped` "
+        "records (emitted by `bench.py skip_record`) in the most recent "
+        "`BENCH_SELF_rNN.json`; the pool has been unreachable since r6. "
+        "They run automatically from `python bench.py` in the next "
+        "hardware window.",
+        "",
+        "| queued row | since | capture | what it measures |",
+        "|---|---|---|---|",
+    ]
+    for q in QUEUED_HARDWARE_ROWS:
+        lines.append(f"| `{q['row']}` | {q['queued_since']} | "
+                     f"`{q['capture']}` | {q['what']} |")
+    return "\n".join(lines)
+
+
+QUEUED_BEGIN = "<!-- QUEUED:BEGIN (generated by `python bench.py --write-queued`) -->"
+QUEUED_END = "<!-- QUEUED:END -->"
+
+
+def write_queued_section(readme_path: str) -> bool:
+    """Replace the README's generated QUEUED block in place; returns
+    whether the file changed (CI uses this as an up-to-date check)."""
+    with open(readme_path) as fh:
+        text = fh.read()
+    begin = text.index(QUEUED_BEGIN) + len(QUEUED_BEGIN)
+    end = text.index(QUEUED_END)
+    new = text[:begin] + "\n" + queued_section() + "\n" + text[end:]
+    if new != text:
+        with open(readme_path, "w") as fh:
+            fh.write(new)
+        return True
+    return False
 
 
 def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
@@ -116,11 +229,16 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
     s.seed()
     # Warm-up: compile + one full run, then rebuild state (the run donated
     # the old buffers) and time a clean run with the executable cached.
-    s.run_to_target()
+    with _trace.span(f"bench.{_ROW_NAME or 'row'}.warmup", cat="bench"):
+        s.run_to_target()
     s.reset_state()
     s.seed()
     t0 = time.perf_counter()
-    stats = s.run_to_target()
+    with _trace.span(f"bench.{_ROW_NAME or 'row'}", cat="bench") as sp:
+        stats = s.run_to_target()
+        if sp is not None:
+            sp.update(n=cfg.n, messages=int(stats.total_message),
+                      ticks=int(stats.round))
     run_s = time.perf_counter() - t0
     ticks = stats.round
     out = {
@@ -147,18 +265,19 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
         hist = telem.gossip_snapshot()
         if hist:
             out["windows"] = hist["count"]
-            out["mail_high_water"] = int(hist["cols"][:hist["count"], 6]
-                                         .max(initial=0))
+            out["mail_high_water"] = int(
+                hist["cols"][:hist["count"], GCOL["mail_high"]]
+                .max(initial=0))
             if cfg.scenario_resolved.active:
                 # Per-window churn telemetry rides the same device-
                 # resident history (cumulative counters per window).
                 c = hist["cols"][:hist["count"]]
                 out["per_window_scenario"] = {
-                    "tick": c[:, 0].tolist(),
-                    "scen_crashed": c[:, 9].tolist(),
-                    "scen_recovered": c[:, 10].tolist(),
-                    "heal_repaired": c[:, 11].tolist(),
-                    "part_dropped": c[:, 12].tolist(),
+                    "tick": c[:, GCOL["tick"]].tolist(),
+                    "scen_crashed": c[:, GCOL["scen_crashed"]].tolist(),
+                    "scen_recovered": c[:, GCOL["recovered"]].tolist(),
+                    "heal_repaired": c[:, GCOL["repaired"]].tolist(),
+                    "part_dropped": c[:, GCOL["part_dropped"]].tolist(),
                 }
     if cfg.scenario_resolved.active:
         out.update(scen_crashed=stats.scen_crashed,
@@ -180,7 +299,38 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
                    deliveries_per_sim_sec=(round(
                        stats.total_message / sim_s, 1)
                        if sim_s > 0 else None))
+    if _RUN_DIR_ROOT and _ROW_NAME:
+        _write_bench_run_dir(cfg, s, out)
     return out
+
+
+def _write_bench_run_dir(cfg: Config, stepper, row: dict) -> None:
+    """One run artifact per bench row (`--run-dir`): same layout the
+    driver writes, so compare_runs.py diffs bench rows and CLI runs
+    interchangeably.  The trajectory comes from the timed run's device
+    history (warm run -- reset_state dropped the warmup's)."""
+    from gossip_simulator_tpu.utils import artifact
+
+    rdir = artifact.RunDir(os.path.join(_RUN_DIR_ROOT, _ROW_NAME))
+    telem = getattr(stepper, "_telem", None)
+    hist_g = telem.gossip_snapshot() if telem is not None else None
+    hist_o = telem.overlay_snapshot() if telem is not None else None
+    traj = artifact.trajectory_from_history(hist_g)
+    result = dict(row)
+    if traj is None:
+        st = stepper.stats()
+        traj = artifact.trajectory_from_rows(
+            [(st.round, st.total_received, st.total_message,
+              st.total_crashed, st.total_removed)])
+        result["fingerprint_basis"] = "final"
+    else:
+        result["fingerprint_basis"] = "telemetry"
+    result["fingerprint"] = artifact.fingerprint_rows(traj)
+    result["fingerprint_windows"] = int(traj.shape[0])
+    rdir.write_config(cfg)
+    rdir.write_env({"bench_row": _ROW_NAME})
+    rdir.write_telemetry(hist_o, hist_g, traj)
+    rdir.write_result(result)
 
 
 def _bench_jax(cfg: Config) -> dict:
@@ -230,7 +380,8 @@ def headline(n: int | None, seed: int) -> dict:
     cfg = Config(n=n, fanout=3, graph="kout", backend="jax", seed=seed,
                  crashrate=0.001, coverage_target=0.90, max_rounds=3000,
                  pallas=on_tpu, progress=False).validate()
-    jx = _bench_jax(cfg)
+    with _named_row("headline_jax"):
+        jx = _bench_jax(cfg)
     # Two baselines, both part of this repo:
     # * python actor loop ("native"): per-node actors + delayed deliveries,
     #   the architecture-faithful stand-in for the reference's
@@ -695,10 +846,11 @@ def full_suite(seed: int) -> list[dict]:
         t0 = time.perf_counter()
         try:
             cfg = cfg.validate()
-            if cfg.backend == "jax":
-                r = _bench_jax(cfg)
-            else:
-                r = _bench_oracle(cfg, budget_s=60.0)
+            with _named_row(name):
+                if cfg.backend == "jax":
+                    r = _bench_jax(cfg)
+                else:
+                    r = _bench_oracle(cfg, budget_s=60.0)
         except Exception as e:  # record, don't kill the suite
             r = {"error": repr(e)}
         r["config"] = name
@@ -739,6 +891,35 @@ def full_suite(seed: int) -> list[dict]:
     return out
 
 
+def cpu_scale_rows(seed: int) -> list[tuple[str, Config]]:
+    """The deterministic CPU-scale capture set behind
+    scripts/check_bench.py: small shapes whose trajectory-derived fields
+    (ticks, coverage, total_message, windows, mail high-water, rumors
+    done) are exact functions of (code, seed) on any host -- a changed
+    value IS a changed trajectory, caught without TPU hardware.  Spans
+    the engine surface: event SI, ring SIR via erdos, multi-rumor
+    oneshot, and streaming injection."""
+    return [
+        ("cpu_si_event_10k", Config(
+            n=10_000, graph="kout", fanout=6, seed=seed, crashrate=0.01,
+            coverage_target=0.95, backend="jax", progress=False,
+            max_rounds=3000)),
+        ("cpu_sir_erdos_10k", Config(
+            n=10_000, graph="erdos", fanout=8, protocol="sir",
+            removal_rate=0.2, seed=seed, backend="jax",
+            coverage_target=0.8, progress=False, max_rounds=3000)),
+        ("cpu_multirumor_10k_r16", Config(
+            n=10_000, graph="kout", fanout=6, rumors=16, seed=seed,
+            crashrate=0.0, coverage_target=0.95, backend="jax",
+            progress=False, max_rounds=3000)),
+        ("cpu_stream_10k", Config(
+            n=10_000, graph="kout", fanout=6, rumors=8, traffic="stream",
+            stream_rate=50, seed=seed, crashrate=0.0,
+            coverage_target=0.95, backend="jax", progress=False,
+            max_rounds=3000)),
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=None)
@@ -746,7 +927,33 @@ def main() -> int:
     ap.add_argument("--full", action="store_true",
                     help="force the full record (suite + 100M + Pallas "
                          "validation) even with an explicit --n")
+    ap.add_argument("--run-dir", default="",
+                    help="write one run artifact per measured row under "
+                         "this directory (utils/artifact.py layout) plus "
+                         "a bench_trace.json span timeline")
+    ap.add_argument("--queued", action="store_true",
+                    help="print the generated QUEUED hardware-rows "
+                         "section and exit")
+    ap.add_argument("--write-queued", action="store_true",
+                    help="regenerate the README's QUEUED section in "
+                         "place and exit (0 = already current)")
     args = ap.parse_args()
+    here_ = os.path.dirname(os.path.abspath(__file__))
+    if args.queued:
+        print(queued_section())
+        return 0
+    if args.write_queued:
+        changed = write_queued_section(os.path.join(here_, "README.md"))
+        print("README QUEUED section "
+              + ("updated" if changed else "already current"))
+        return 1 if changed else 0
+    global _RUN_DIR_ROOT
+    tracer = None
+    if args.run_dir:
+        _RUN_DIR_ROOT = os.path.abspath(args.run_dir)
+        os.makedirs(_RUN_DIR_ROOT, exist_ok=True)
+        tracer = _trace.activate(_trace.Tracer(
+            path=os.path.join(_RUN_DIR_ROOT, "bench_trace.json")))
     # The driver invokes plain `python bench.py`: the default invocation IS
     # the full record (BASELINE suite + Pallas validation + 100M rows).
     # An explicit --n is a smoke run and skips all of it unless --full.
@@ -806,6 +1013,9 @@ def main() -> int:
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "bench_out.json"), "w") as fh:
         json.dump(result, fh, indent=1)
+    if tracer is not None:
+        tracer.write(metadata={"kind": "bench", "seed": args.seed})
+        _trace.deactivate()
     line = {k: v for k, v in result.items() if k != "detail"}
     d = result["detail"]
     for row in ("jax_100m_99pct", "jax_100m_99pct_nosuppress", "jax_100m",

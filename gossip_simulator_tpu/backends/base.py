@@ -117,32 +117,47 @@ def run_bounded_to_target(stepper) -> Stats:
     cfg = stepper.cfg
     from gossip_simulator_tpu.models import epidemic
 
+    from gossip_simulator_tpu.utils import trace as _trace
+
     target = int(np.ceil(cfg.coverage_target * cfg.n))
     budget = epidemic.run_call_budget(cfg)
     tick = int(jax.device_get(stepper.state.tick))
     telem = getattr(stepper, "_telem", None)
     hist = telem.begin_gossip() if telem is not None else None
+    calls = 0
     while True:
         until = min(cfg.max_rounds, tick + budget)
         t0 = time.perf_counter()
-        if hist is not None:
-            stepper.state, hist = stepper._run_fn(
-                stepper.state, stepper.key, np.int32(target),
-                np.int32(until), hist)
-        else:
-            stepper.state = stepper._run_fn(stepper.state, stepper.key,
-                                            np.int32(target), np.int32(until))
-        st = stepper.state
-        from gossip_simulator_tpu.models.event import in_flight as _inflight
+        # Span per bounded device call: the first one is dominated by
+        # trace+compile (the telemetry ledger's compile_s), later ones are
+        # pure execution -- the name says which, so the trace separates
+        # compile cost from steady-state throughput at a glance.
+        with _trace.span("phase2.compile+run" if calls == 0
+                         else "phase2.bounded_call", cat="device") as sp:
+            if hist is not None:
+                stepper.state, hist = stepper._run_fn(
+                    stepper.state, stepper.key, np.int32(target),
+                    np.int32(until), hist)
+            else:
+                stepper.state = stepper._run_fn(
+                    stepper.state, stepper.key,
+                    np.int32(target), np.int32(until))
+            st = stepper.state
+            from gossip_simulator_tpu.models.event import \
+                in_flight as _inflight
 
-        import jax.numpy as jnp
+            import jax.numpy as jnp
 
-        # Multi-rumor convergence is the WORST rumor: the loop runs until
-        # every rumor's per-rumor count reaches the target.
-        recv_metric = (jnp.min(st.rumor_recv[:cfg.rumors])
-                       if cfg.multi_rumor else st.total_received)
-        tick, recv, in_flight = (int(x) for x in jax.device_get(
-            (st.tick, recv_metric, _inflight(st))))
+            # Multi-rumor convergence is the WORST rumor: the loop runs
+            # until every rumor's per-rumor count reaches the target.
+            recv_metric = (jnp.min(st.rumor_recv[:cfg.rumors])
+                           if cfg.multi_rumor else st.total_received)
+            tick, recv, in_flight = (int(x) for x in jax.device_get(
+                (st.tick, recv_metric, _inflight(st))))
+            if sp is not None:
+                sp.update(until=int(until), tick=tick, received=recv,
+                          in_flight=in_flight)
+        calls += 1
         if telem is not None:
             telem.tally_gossip_call(time.perf_counter() - t0)
         # Exhaustion is recorded whatever ends the run (the windowed loop's

@@ -161,11 +161,14 @@ class JaxStepper(Stepper):
             # dispatch + quiescence sync is noise.  Telemetry records
             # host-side here, riding the per-round device_get the split
             # already pays.
+            from gossip_simulator_tpu.utils import trace as _trace
+
             oq = self._quiesced_jit()
             q = False
             while self._overlay_rounds < max_windows:
                 t0 = time.perf_counter()
-                self._advance_overlay()
+                with _trace.span("phase1.split_round", cat="device"):
+                    self._advance_overlay()
                 self._overlay_rounds += 1
                 self._phase1_ms = self._overlay_rounds * self._mean_delay
                 # Round 7: with the dead-row skip on, the split round
@@ -199,22 +202,31 @@ class JaxStepper(Stepper):
             # Watchdog-bounded windows per device call; the calibration
             # lives with each overlay module's cost model.
             budget = self._omod.run_call_budget(self.cfg)
+        from gossip_simulator_tpu.utils import trace as _trace
+
         hist = telem.begin_overlay(max_windows) if telem is not None else None
         q = False
+        calls = 0
         while True:
             lim = min(budget, max_windows - self._overlay_rounds)
             if lim <= 0:
                 break
             t0 = time.perf_counter()
-            if hist is not None:
-                self.ostate, polls, q, hist = self._orun(
-                    self.ostate, self.key, np.int32(lim), hist)
-            else:
-                self.ostate, polls, q = self._orun(self.ostate, self.key,
-                                                   np.int32(lim))
-            faithful = self._faithful_overlay
-            tick = self.ostate.tick if faithful else 0
-            polls, q, tick = jax.device_get((polls, q, tick))
+            with _trace.span("phase1.compile+run" if calls == 0
+                             else "phase1.bounded_call",
+                             cat="device") as sp:
+                if hist is not None:
+                    self.ostate, polls, q, hist = self._orun(
+                        self.ostate, self.key, np.int32(lim), hist)
+                else:
+                    self.ostate, polls, q = self._orun(
+                        self.ostate, self.key, np.int32(lim))
+                faithful = self._faithful_overlay
+                tick = self.ostate.tick if faithful else 0
+                polls, q, tick = jax.device_get((polls, q, tick))
+                if sp is not None:
+                    sp.update(windows=int(polls))
+            calls += 1
             if telem is not None:
                 telem.tally_overlay_call(time.perf_counter() - t0)
             self._overlay_rounds += int(polls)

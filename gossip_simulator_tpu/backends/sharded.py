@@ -182,22 +182,35 @@ class ShardedStepper(Stepper):
             # (scaled inside run_call_budget, before its >=1 clamp).
             budget = omod.run_call_budget(self.cfg,
                                           shards=self.mesh.shape[AXIS])
+        from gossip_simulator_tpu.utils import trace as _trace
+
         faithful = getattr(self, "_faithful_overlay", False)
         hist = telem.begin_overlay(max_windows) if telem is not None else None
         q = False
+        calls = 0
         while True:
             lim = min(budget, max_windows - self._overlay_rounds)
             if lim <= 0:
                 break
             t0 = time.perf_counter()
-            if hist is not None:
-                self.ostate, polls, q, hist = self._orun(
-                    self.ostate, self.key, np.int32(lim), hist)
-            else:
-                self.ostate, polls, q = self._orun(self.ostate, self.key,
-                                                   np.int32(lim))
-            tick = self.ostate.tick if faithful else 0
-            polls, q, tick = jax.device_get((polls, q, tick))
+            # Each bounded call dispatches the shard_map'd poll: the
+            # cross-shard all_to_all exchange lives inside it, so this
+            # span IS the host-visible "sharded exchange" cost envelope.
+            with _trace.span("phase1.compile+run" if calls == 0
+                             else "phase1.sharded_call",
+                             cat="device") as sp:
+                if hist is not None:
+                    self.ostate, polls, q, hist = self._orun(
+                        self.ostate, self.key, np.int32(lim), hist)
+                else:
+                    self.ostate, polls, q = self._orun(
+                        self.ostate, self.key, np.int32(lim))
+                tick = self.ostate.tick if faithful else 0
+                polls, q, tick = jax.device_get((polls, q, tick))
+                if sp is not None:
+                    sp.update(windows=int(polls),
+                              shards=int(self.mesh.shape[AXIS]))
+            calls += 1
             if telem is not None:
                 telem.tally_overlay_call(time.perf_counter() - t0)
             self._overlay_rounds += int(polls)
@@ -250,8 +263,12 @@ class ShardedStepper(Stepper):
 
     def gossip_window(self) -> Stats:
         from gossip_simulator_tpu.models.event import in_flight as _inflight
+        from gossip_simulator_tpu.utils import trace as _trace
 
-        self.state = self._window_fn(self.state, self.key)
+        # The per-window sharded dispatch (all_to_all exchange inside).
+        with _trace.span("phase2.sharded_window", cat="device",
+                         shards=int(self.mesh.shape[AXIS])):
+            self.state = self._window_fn(self.state, self.key)
         stats = self.stats()
         in_flight = int(jax.device_get(_inflight(self.state)))
         # Heal-on runs never report exhaustion mid-run (see

@@ -63,7 +63,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     # when the run raises (metrics.ProgressPrinter.__exit__).
     with ProgressPrinter(
             enabled=cfg.progress,
-            jsonl_path=(cfg.log_jsonl or None) if not silent else None,
+            jsonl_path=((cfg.log_jsonl_resolved or None)
+                        if not silent else None),
             silent=silent) as printer:
         result = run_simulation(cfg, printer=printer, silent=silent)
     return 0 if result.converged else 2

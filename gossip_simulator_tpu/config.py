@@ -210,6 +210,22 @@ class Config:
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
+    # --- flight recorder (utils/trace.py, utils/artifact.py) -----------------
+    # Host-side span trace (compile, phase-1 rounds, phase-2 windows,
+    # checkpoint save/load, sharded exchange) as Chrome trace-event JSON
+    # to this path.  Pure host-side observability: the traced jitted
+    # programs are unchanged, so trajectories stay bit-identical.
+    trace: str = ""
+    # jax.profiler device profile wrapping the whole run, with a
+    # TraceAnnotation per host span so the TensorBoard device timeline
+    # lines up with the -trace spans (unlike -profile, which wraps only
+    # phase 2 and carries no span names).
+    xprof_dir: str = ""
+    # Write a self-describing run artifact here: config snapshot +
+    # resolved gates, platform/env fingerprint, JSONL metrics, telemetry
+    # histories (npz), trace file, final Stats and the trajectory
+    # fingerprint.  scripts/compare_runs.py diffs two of these.
+    run_dir: str = ""
     # Append structured JSONL records (params, per-window progress, totals,
     # wall-clock) to this path, alongside the reference-format stdout.
     log_jsonl: str = ""
@@ -467,6 +483,66 @@ class Config:
             return ""
         from gossip_simulator_tpu.ops import pallas_deliver
         return pallas_deliver.tpu_unsupported()
+
+    def resolved_gates(self) -> dict:
+        """The resolved gate set, stamped into run artifacts and the
+        terminal `result` record so a trajectory is attributable without
+        re-deriving auto resolutions from argv.  deliver_kernel resolves
+        lazily via the jax capability probe, so it is only consulted on
+        the jax/sharded backends (post-setup); the oracles report None.
+        Safe to call on any validated config -- an unavailable explicit
+        `-deliver-kernel pallas` reports "unavailable" rather than
+        raising (the run itself raises at model-build time).  Only
+        TRAJECTORY-affecting gates belong here: observability toggles
+        (telemetry, checkpointing) are excluded on purpose so a
+        telemetry-on/off twin pair's `result` records stay
+        field-identical (the fast-path replay parity tests compare
+        them)."""
+        gates = {
+            "engine": self.engine_resolved,
+            "overlay_mode": self.overlay_mode_resolved,
+            "compact": self.compact_resolved,
+            "overlay_adaptive_chunks": self.overlay_adaptive_chunks_resolved,
+            "overlay_dead_skip": self.overlay_dead_skip_resolved,
+            "overlay_heal": self.overlay_heal_resolved,
+            "dup_suppress": self.dup_suppress_resolved,
+            "multi_rumor": self.multi_rumor,
+            "time_mode": self.effective_time_mode,
+        }
+        if self.backend in ("jax", "sharded"):
+            try:
+                gates["deliver_kernel"] = self.deliver_kernel_resolved
+            except ValueError:
+                gates["deliver_kernel"] = "unavailable"
+        else:
+            gates["deliver_kernel"] = None
+        return gates
+
+    @property
+    def log_jsonl_resolved(self) -> str:
+        """JSONL destination: an explicit -log-jsonl wins; otherwise a
+        -run-dir run logs into its own artifact (metrics.jsonl) so the
+        dir is complete without extra flags."""
+        if self.log_jsonl:
+            return self.log_jsonl
+        if self.run_dir:
+            import os
+
+            return os.path.join(self.run_dir, "metrics.jsonl")
+        return ""
+
+    @property
+    def trace_resolved(self) -> str:
+        """Trace destination: explicit -trace wins; a -run-dir run traces
+        into its artifact by default (host-side only -- the traced jitted
+        programs are unchanged either way)."""
+        if self.trace:
+            return self.trace
+        if self.run_dir:
+            import os
+
+            return os.path.join(self.run_dir, "trace.json")
+        return ""
 
     def static_boot_for(self, n_rows: int) -> bool:
         """One-shot static bootstrap for a ROUNDS-overlay surface of
@@ -909,6 +985,20 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)
+    p.add_argument("-trace", "--trace", default=d.trace,
+                   help="write host-side phase/window spans as Chrome "
+                        "trace-event JSON to this path")
+    p.add_argument("-xprof", "--xprof", dest="xprof_dir",
+                   default=d.xprof_dir,
+                   help="wrap the run in a jax.profiler device trace "
+                        "(TensorBoard dir), with one TraceAnnotation per "
+                        "host span so device and host timelines align")
+    p.add_argument("-run-dir", "--run-dir", dest="run_dir",
+                   default=d.run_dir,
+                   help="write a self-describing run artifact (config, "
+                        "env fingerprint, JSONL metrics, telemetry npz, "
+                        "trace, result + trajectory fingerprint) into "
+                        "this directory; see scripts/compare_runs.py")
     p.add_argument("-log-jsonl", "--log-jsonl", dest="log_jsonl",
                    default=d.log_jsonl,
                    help="append structured JSONL progress records here")

@@ -18,6 +18,7 @@ from gossip_simulator_tpu.backends import make_stepper
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.utils import telemetry as _telemetry
+from gossip_simulator_tpu.utils import trace as _trace
 from gossip_simulator_tpu.utils.metrics import ProgressPrinter, Stats
 
 
@@ -41,12 +42,25 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
     own_printer = printer is None
     printer = printer or ProgressPrinter(
         enabled=cfg.progress,
-        jsonl_path=(cfg.log_jsonl or None) if not silent else None,
+        jsonl_path=(cfg.log_jsonl_resolved or None) if not silent else None,
         silent=silent)
+    # Flight recorder (utils/trace.py): one tracer per run, activated for
+    # the module-level span() sites in backends/checkpoint.  Host-side
+    # only -- the traced jitted programs are unchanged -- and skipped on
+    # non-primary ranks (they would race on the same file).
+    tracer = None
+    if (cfg.trace_resolved or cfg.xprof_dir) and not silent:
+        tracer = _trace.Tracer(path=cfg.trace_resolved,
+                               xprof_dir=cfg.xprof_dir)
     try:
-        return _run(cfg, printer, stepper)
+        with _trace.activated(tracer):
+            return _run(cfg, printer, stepper)
     finally:
-        # Close on ANY exit so a raised run still flushes the JSONL log.
+        # Close on ANY exit so a raised run still flushes the JSONL log
+        # (and the trace file persists what a crashed run got through).
+        if tracer is not None and tracer.path:
+            tracer.write(metadata={"n": cfg.n, "backend": cfg.backend,
+                                   "seed": cfg.seed})
         if own_printer:
             printer.close()
 
@@ -69,7 +83,8 @@ def _run(cfg: Config, printer: ProgressPrinter,
             + (f" (detect {cfg.heal_detect_ms}ms)"
                if cfg.overlay_heal_resolved else ""))
     t_init = time.perf_counter()
-    stepper.init()
+    with _trace.span("init", cat="phase"):
+        stepper.init()
     # The telemetry session (utils/telemetry.py) lets an observing run --
     # progress lines or JSONL -- take the device-side fast paths anyway:
     # the jitted loops record the full per-window trajectory on device and
@@ -149,8 +164,11 @@ def _run(cfg: Config, printer: ProgressPrinter,
         if ((not printer.observing or telem is not None)
                 and not cfg.checkpointing_enabled
                 and hasattr(stepper, "overlay_run_to_quiescence")):
-            overlay_windows, oq = stepper.overlay_run_to_quiescence(
-                max_overlay_windows)
+            with _trace.span("phase1.quiesce", cat="phase") as sp:
+                overlay_windows, oq = stepper.overlay_run_to_quiescence(
+                    max_overlay_windows)
+                if sp is not None:
+                    sp["windows"] = int(overlay_windows)
             if not oq:
                 raise RuntimeError(
                     f"overlay did not stabilize within {max_overlay_windows} "
@@ -165,7 +183,11 @@ def _run(cfg: Config, printer: ProgressPrinter,
                     clock_scale=getattr(stepper, "overlay_clock_scale", 1.0))
         else:
             while True:
-                makeups, breakups, quiesced = stepper.overlay_window()
+                with _trace.span("phase1.window", cat="window") as sp:
+                    makeups, breakups, quiesced = stepper.overlay_window()
+                    if sp is not None:
+                        sp.update(makeups=int(makeups),
+                                  breakups=int(breakups))
                 overlay_windows += 1
                 if quiesced:
                     break
@@ -211,9 +233,20 @@ def _run(cfg: Config, printer: ProgressPrinter,
     fast = (not resumed and not cfg.checkpointing_enabled
             and (not printer.observing or telem is not None)
             and hasattr(stepper, "run_to_target"))
+    # Per-window trajectory rows for the run artifact (`-run-dir`): the
+    # fast path derives them from the telemetry history afterward; the
+    # windowed loop collects them here (artifact.TRAJECTORY_COLS order --
+    # Stats.round IS the recorded tick, so the two bases are identical).
+    window_rows: list = []
+    collect_rows = bool(cfg.run_dir) and not printer.silent
     with _maybe_profile(cfg):
         if fast:
-            stats = stepper.run_to_target()
+            with _trace.span("phase2.run_to_target", cat="phase") as sp:
+                stats = stepper.run_to_target()
+                if sp is not None:
+                    sp.update(rounds=int(stats.round),
+                              messages=int(stats.total_message),
+                              received=int(stats.total_received))
             hist2 = telem.gossip_snapshot() if telem is not None else None
             if hist2 and printer.observing:
                 _telemetry.replay_gossip(printer, hist2, n=cfg.n)
@@ -223,8 +256,19 @@ def _run(cfg: Config, printer: ProgressPrinter,
             converged = stats.coverage >= target
         else:
             while gossip_windows < max_windows:
-                stats = stepper.gossip_window()
+                with _trace.span("phase2.window", cat="window") as sp:
+                    stats = stepper.gossip_window()
+                    if sp is not None:
+                        sp.update(round=int(stats.round),
+                                  received=int(stats.total_received),
+                                  messages=int(stats.total_message),
+                                  dropped=int(stats.mailbox_dropped))
                 gossip_windows += 1
+                if collect_rows:
+                    window_rows.append((stats.round, stats.total_received,
+                                        stats.total_message,
+                                        stats.total_crashed,
+                                        stats.total_removed))
                 pct = stats.coverage * 100.0
                 printer.coverage_window(round(pct, 4), stepper.sim_time_ms())
                 # Offset by the restored window so post-resume snapshot
@@ -263,6 +307,10 @@ def _run(cfg: Config, printer: ProgressPrinter,
         "overlay_windows": overlay_windows,
         "gossip_windows": gossip_windows,
         "reason": None if converged else reason,
+        # Attribution without re-parsing argv: where this run's artifact
+        # landed (None without -run-dir) and the resolved gate set.
+        "run_dir": (os.path.abspath(cfg.run_dir) if cfg.run_dir else None),
+        "gates": cfg.resolved_gates(),
         **stats.to_dict(),
     }
     if cfg.multi_rumor:
@@ -281,7 +329,43 @@ def _run(cfg: Config, printer: ProgressPrinter,
         printer.telemetry(report.summary())
         if cfg.telemetry_summary:
             printer.block(report.summary_block())
+    if cfg.run_dir and not printer.silent:
+        _write_run_dir(cfg, telem, window_rows, payload, stats)
     return result
+
+
+def _write_run_dir(cfg: Config, telem, window_rows: list, payload: dict,
+                   stats: Stats) -> None:
+    """Flush the `-run-dir` artifact (utils/artifact.py layout).  The
+    trajectory prefers the device-recorded history (fast path), falls
+    back to the windowed loop's host-collected rows, and degrades to a
+    single final-Stats row only when neither existed (a silent rank or a
+    telemetry-off oracle-free fast path) -- the basis is named so
+    compare_runs can refuse apples-to-oranges fingerprints."""
+    from gossip_simulator_tpu.utils import artifact
+
+    rdir = artifact.RunDir(cfg.run_dir)
+    hist_o = telem.overlay_snapshot() if telem is not None else None
+    hist_g = telem.gossip_snapshot() if telem is not None else None
+    traj = artifact.trajectory_from_history(hist_g)
+    basis = "telemetry"
+    if traj is None:
+        traj = artifact.trajectory_from_rows(window_rows)
+        basis = "windows"
+    if traj is None:
+        traj = artifact.trajectory_from_rows(
+            [(stats.round, stats.total_received, stats.total_message,
+              stats.total_crashed, stats.total_removed)])
+        basis = "final"
+    rdir.write_config(cfg)
+    rdir.write_env()
+    rdir.write_telemetry(hist_o, hist_g, traj)
+    rdir.write_result({
+        **payload,
+        "fingerprint": artifact.fingerprint_rows(traj),
+        "fingerprint_windows": int(traj.shape[0]),
+        "fingerprint_basis": basis,
+    })
 
 
 def _multi_rumor_report(cfg: Config, stepper: Stepper, stats: Stats,

@@ -1,0 +1,205 @@
+"""Self-describing run artifacts (`-run-dir DIR`) and trajectory fingerprints.
+
+A run dir is the unit `scripts/compare_runs.py` diffs and the substrate
+every future hardware claim reports through (ROADMAP item 1): one
+directory holding everything needed to attribute, replay and compare a
+run without re-parsing argv or git-stashing twins:
+
+    run-dir/
+      config.json     flag snapshot + the resolved gate set
+      env.json        platform fingerprint (jax/numpy/python versions,
+                      backend, device count/kind, hostname, argv)
+      metrics.jsonl   the structured JSONL log (schema v3, header first)
+      telemetry.npz   fetched per-window histories + canonical trajectory
+      trace.json      Chrome trace-event spans (when tracing is on)
+      result.json     final Stats / RunResult payload + the trajectory
+                      fingerprint
+
+The **trajectory fingerprint** is the per-window
+``(round, total_received, total_message, total_crashed, total_removed)``
+row list hashed as sha256-of-JSON (first 16 hex chars) -- the same
+convention the fingerprint-pin tests use.  The rows come from the
+telemetry history on the fast path and from per-window Stats on the
+windowed loop; the two bases are identical (`Stats.round` IS the recorded
+tick column, and telemetry replay is byte-parity-pinned), so fingerprints
+compare across paths.  A run with no per-window record at all (telemetry
+off AND nothing observing) falls back to a single final-Stats row and says
+so (``fingerprint_basis: "final"``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import socket
+import sys
+from typing import Optional
+
+import numpy as np
+
+# Canonical trajectory column order (one row per poll window).
+TRAJECTORY_COLS = ("round", "total_received", "total_message",
+                   "total_crashed", "total_removed")
+
+
+def fingerprint_rows(rows) -> str:
+    """sha256-of-JSON over int rows, first 16 hex chars (the repo's
+    fingerprint-pin convention, tests/test_multirumor.py)."""
+    payload = json.dumps([[int(v) for v in r] for r in rows]).encode()
+    return hashlib.sha256(payload).hexdigest()[:16]
+
+
+def trajectory_from_history(hist: Optional[dict]) -> Optional[np.ndarray]:
+    """Canonical int64 [count, 5] trajectory from a fetched gossip history
+    (utils/telemetry.fetch_history shape)."""
+    if not hist or not hist.get("count"):
+        return None
+    from gossip_simulator_tpu.utils import telemetry
+
+    count = hist["count"]
+    cols = hist["cols"][:count]
+    g = telemetry.GCOL
+    msg = telemetry._msg64_col(cols).astype(np.int64)
+    out = np.empty((count, len(TRAJECTORY_COLS)), np.int64)
+    out[:, 0] = cols[:, g["tick"]]
+    out[:, 1] = cols[:, g["received"]]
+    out[:, 2] = msg
+    out[:, 3] = cols[:, g["crashed"]]
+    out[:, 4] = cols[:, g["removed"]]
+    return out
+
+
+def trajectory_from_rows(rows: list) -> Optional[np.ndarray]:
+    """Same canonical array from host-collected per-window Stats rows."""
+    if not rows:
+        return None
+    return np.asarray(rows, np.int64).reshape(len(rows),
+                                              len(TRAJECTORY_COLS))
+
+
+def env_fingerprint() -> dict:
+    """Platform/environment fingerprint: enough to attribute a perf delta
+    to a software or hardware change before suspecting the code."""
+    import platform
+
+    out = {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "hostname": socket.gethostname(),
+        "argv": list(sys.argv),
+    }
+    try:
+        out["numpy"] = np.__version__
+    except Exception:
+        pass
+    try:
+        import jax
+
+        out["jax"] = jax.__version__
+        devs = jax.devices()
+        out["backend_platform"] = devs[0].platform if devs else "none"
+        out["device_count"] = len(devs)
+        kinds = sorted({getattr(d, "device_kind", "?") for d in devs})
+        out["device_kind"] = kinds[0] if len(kinds) == 1 else kinds
+    except Exception as e:  # pragma: no cover - jax is baked into the image
+        out["jax_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+class RunDir:
+    """Writer for one run's artifact directory.
+
+    Construction creates the directory; the driver (or bench) then calls
+    the ``write_*`` methods as each artifact becomes available.  All
+    writes are small JSON/npz files at run boundaries -- nothing here
+    touches the hot path.
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(self.path, exist_ok=True)
+
+    def file(self, name: str) -> str:
+        return os.path.join(self.path, name)
+
+    @property
+    def metrics_path(self) -> str:
+        return self.file("metrics.jsonl")
+
+    @property
+    def trace_path(self) -> str:
+        return self.file("trace.json")
+
+    def _write_json(self, name: str, doc: dict) -> str:
+        out = self.file(name)
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
+            f.write("\n")
+        os.replace(tmp, out)
+        return out
+
+    def write_config(self, cfg) -> str:
+        doc = {"flags": dataclasses.asdict(cfg),
+               "resolved": cfg.resolved_gates()}
+        return self._write_json("config.json", doc)
+
+    def write_env(self, extra: Optional[dict] = None) -> str:
+        doc = env_fingerprint()
+        if extra:
+            doc.update(extra)
+        return self._write_json("env.json", doc)
+
+    def write_telemetry(self, overlay: Optional[dict],
+                        gossip: Optional[dict],
+                        trajectory: Optional[np.ndarray]) -> Optional[str]:
+        """One npz holding both fetched histories (named-column layouts
+        from utils/telemetry) plus the canonical trajectory."""
+        arrays: dict = {}
+        from gossip_simulator_tpu.utils import telemetry
+
+        if gossip is not None:
+            arrays["gossip_cols"] = gossip["cols"][:gossip["count"]]
+            arrays["gossip_count"] = np.int64(gossip["count"])
+            arrays["gossip_names"] = np.array(telemetry.GOSSIP_COLS)
+        if overlay is not None:
+            arrays["overlay_cols"] = overlay["cols"][:overlay["count"]]
+            arrays["overlay_count"] = np.int64(overlay["count"])
+            arrays["overlay_names"] = np.array(telemetry.OVERLAY_COLS)
+        if trajectory is not None:
+            arrays["trajectory"] = trajectory
+            arrays["trajectory_names"] = np.array(TRAJECTORY_COLS)
+        if not arrays:
+            return None
+        out = self.file("telemetry.npz")
+        tmp = out + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, out)
+        return out
+
+    def write_result(self, payload: dict) -> str:
+        return self._write_json("result.json", payload)
+
+
+def load_run(path: str) -> dict:
+    """Read a run dir back for comparison: the JSON artifacts plus the
+    npz arrays (lazily OK -- these are small).  Raises FileNotFoundError
+    with a named missing artifact so compare_runs can exit 2 cleanly."""
+    out: dict = {"path": os.path.abspath(path)}
+    for name in ("config", "env", "result"):
+        p = os.path.join(path, name + ".json")
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"{path}: missing {name}.json "
+                                    "(not a run dir?)")
+        with open(p) as f:
+            out[name] = json.load(f)
+    npz = os.path.join(path, "telemetry.npz")
+    if os.path.exists(npz):
+        with np.load(npz, allow_pickle=False) as z:
+            out["telemetry"] = {k: z[k] for k in z.files}
+    else:
+        out["telemetry"] = {}
+    return out

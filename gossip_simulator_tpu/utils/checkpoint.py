@@ -43,25 +43,29 @@ def save(ckpt_dir: str, window: int, tree: dict[str, Any], stats: Stats,
     snapshot corrupted AFTER a clean save (truncation, bit rot, a partial
     copy between filesystems) is rejected with a clear error instead of
     restoring garbage."""
+    from gossip_simulator_tpu.utils import trace as _trace
+
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"{prefix}_{window:08d}.npz")
-    arrays = {k: np.asarray(v) for k, v in tree.items()}
-    tmp = path + ".tmp"
-    # np.savez appends ".npz" to names without it -- write under the real
-    # suffix structure by handing it a file object.
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **arrays)
-    meta = {"window": window, **(extra_meta or {}), **stats.to_dict(),
-            "sha256": _digest(tmp)}
-    with open(path + ".json.tmp", "w") as f:
-        json.dump(meta, f)
-        f.flush()
-        os.fsync(f.fileno())
-    # Sidecar lands first: a crash between the two replaces leaves a
-    # (new json, old/no npz) pair, which load() rejects via the digest --
-    # never silently restores a mismatched pair.
-    os.replace(path + ".json.tmp", path + ".json")
-    os.replace(tmp, path)
+    with _trace.span("checkpoint.save", cat="io", prefix=prefix,
+                     window=window):
+        arrays = {k: np.asarray(v) for k, v in tree.items()}
+        tmp = path + ".tmp"
+        # np.savez appends ".npz" to names without it -- write under the
+        # real suffix structure by handing it a file object.
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        meta = {"window": window, **(extra_meta or {}), **stats.to_dict(),
+                "sha256": _digest(tmp)}
+        with open(path + ".json.tmp", "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # Sidecar lands first: a crash between the two replaces leaves a
+        # (new json, old/no npz) pair, which load() rejects via the digest
+        # -- never silently restores a mismatched pair.
+        os.replace(path + ".json.tmp", path + ".json")
+        os.replace(tmp, path)
     return path
 
 
@@ -77,26 +81,30 @@ def load(path: str) -> tuple[dict[str, np.ndarray], dict]:
     when present (pre-digest snapshots load without the check).  A
     truncated, torn or bit-rotted file raises ValueError naming the
     snapshot instead of feeding garbage to the restore path."""
-    meta = {}
-    if os.path.exists(path + ".json"):
-        with open(path + ".json") as f:
-            meta = json.load(f)
-    want = meta.get("sha256")
-    if want is not None:
-        got = _digest(path)
-        if got != want:
+    from gossip_simulator_tpu.utils import trace as _trace
+
+    with _trace.span("checkpoint.load", cat="io",
+                     file=os.path.basename(path)):
+        meta = {}
+        if os.path.exists(path + ".json"):
+            with open(path + ".json") as f:
+                meta = json.load(f)
+        want = meta.get("sha256")
+        if want is not None:
+            got = _digest(path)
+            if got != want:
+                raise ValueError(
+                    f"checkpoint {path} is corrupt: content digest "
+                    f"{got[:16]}… does not match its sidecar's "
+                    f"{want[:16]}… (truncated or torn write?) -- delete "
+                    "it and resume from an older snapshot")
+        try:
+            arrays = dict(np.load(path))
+        except Exception as e:
             raise ValueError(
-                f"checkpoint {path} is corrupt: content digest {got[:16]}… "
-                f"does not match its sidecar's {want[:16]}… (truncated or "
-                "torn write?) -- delete it and resume from an older "
-                "snapshot")
-    try:
-        arrays = dict(np.load(path))
-    except Exception as e:
-        raise ValueError(
-            f"checkpoint {path} is unreadable ({e!r}); delete it and "
-            "resume from an older snapshot") from e
-    return arrays, meta
+                f"checkpoint {path} is unreadable ({e!r}); delete it and "
+                "resume from an older snapshot") from e
+        return arrays, meta
 
 
 def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:

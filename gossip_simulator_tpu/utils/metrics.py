@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import time
 from typing import Optional
@@ -26,8 +27,26 @@ from typing import Optional
 # machine-consumable without sniffing.  v2 added `schema_version` itself,
 # the terminal `event="result"` record (full RunResult + wall-time
 # breakdown), `Stats.exhausted`, and the fast-path `event="telemetry"`
-# report (utils/telemetry.py).
-SCHEMA_VERSION = 2
+# report (utils/telemetry.py).  v3 opens every stream with an
+# `event="header"` record naming the telemetry history columns (the named
+# schema replacing positional "14th column" indexing) and adds
+# `run_dir` + the resolved gate set to the terminal `result` record.
+SCHEMA_VERSION = 3
+
+
+def header_record() -> dict:
+    """The v3 stream header: the column schemas every downstream consumer
+    needs to read telemetry histories / npz artifacts without hard-coding
+    positions.  Deterministic (no wall clock beyond the stamp `_record`
+    adds), so twin streams stay comparable."""
+    from gossip_simulator_tpu.utils.artifact import TRAJECTORY_COLS
+    from gossip_simulator_tpu.utils.telemetry import (GOSSIP_COLS,
+                                                      OVERLAY_COLS)
+
+    return {"event": "header",
+            "columns": {"gossip": list(GOSSIP_COLS),
+                        "overlay": list(OVERLAY_COLS),
+                        "trajectory": list(TRAJECTORY_COLS)}}
 
 
 @dataclasses.dataclass
@@ -95,7 +114,12 @@ class ProgressPrinter:
         self.enabled = enabled
         self.silent = silent
         self.out = out or sys.stdout
+        if jsonl_path:
+            # A -run-dir run logs into its (not-yet-created) artifact dir.
+            parent = os.path.dirname(os.path.abspath(jsonl_path))
+            os.makedirs(parent, exist_ok=True)
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._header_written = False
         self._t0 = time.perf_counter()
 
     @property
@@ -113,6 +137,11 @@ class ProgressPrinter:
     def _record(self, **record):
         """JSONL-only record (no stdout line)."""
         if self._jsonl:
+            if not self._header_written:
+                # v3: the stream opens with the column-schema header,
+                # written lazily so a run that never logs stays empty.
+                self._header_written = True
+                self._record(**header_record())
             record["schema_version"] = SCHEMA_VERSION
             record["wall_s"] = time.perf_counter() - self._t0
             self._jsonl.write(json.dumps(record) + "\n")

@@ -43,6 +43,14 @@ GOSSIP_COLS = ("tick", "received", "msg_hi", "msg_lo", "crashed", "removed",
                "recovered", "repaired", "part_dropped", "rumors_done")
 OVERLAY_COLS = ("clock", "makeups", "breakups", "dropped")
 
+# Named column indices -- THE way to address a history column (schema v3
+# names these in the JSONL header).  Positional literals ("the 14th
+# column") drifted once per added column; every reader below and every
+# external consumer (bench.py, utils/artifact.py, scripts) goes through
+# these maps instead.
+GCOL = {name: i for i, name in enumerate(GOSSIP_COLS)}
+OCOL = {name: i for i, name in enumerate(OVERLAY_COLS)}
+
 
 class History(NamedTuple):
     """Device-resident per-window ring: `idx` rows written (keeps counting
@@ -162,8 +170,9 @@ def replay_overlay(printer, hist: Optional[dict], clock_scale: float,
         # clock_scale 1.0 (faithful ticks) reproduces float(tick) exactly;
         # the rounds engine's round * mean_delay is the windowed loop's
         # identical float expression.
-        printer.overlay_window(int(cols[i, 2]), int(cols[i, 1]),
-                               float(cols[i, 0]) * clock_scale)
+        printer.overlay_window(int(cols[i, OCOL["breakups"]]),
+                               int(cols[i, OCOL["makeups"]]),
+                               float(cols[i, OCOL["clock"]]) * clock_scale)
 
 
 def replay_gossip(printer, hist: Optional[dict], n: int) -> None:
@@ -173,13 +182,15 @@ def replay_gossip(printer, hist: Optional[dict], n: int) -> None:
         return
     cols = hist["cols"]
     for i in range(hist["count"]):
-        pct = (int(cols[i, 1]) / n if n else 0.0) * 100.0
-        printer.coverage_window(round(pct, 4), float(cols[i, 0]))
+        pct = (int(cols[i, GCOL["received"]]) / n if n else 0.0) * 100.0
+        printer.coverage_window(round(pct, 4), float(cols[i, GCOL["tick"]]))
 
 
 def _msg64_col(cols: np.ndarray) -> np.ndarray:
     """Reassemble the bitcast [hi, lo] int32 column pair into uint64."""
-    pair = cols[:, 2:4].astype(np.int32).view(np.uint32).astype(np.uint64)
+    hi = GCOL["msg_hi"]
+    pair = cols[:, hi:hi + 2].astype(np.int32).view(np.uint32) \
+        .astype(np.uint64)
     return (pair[:, 0] << np.uint64(32)) | pair[:, 1]
 
 
@@ -296,8 +307,13 @@ class TelemetryReport:
             if self.gossip["truncated"]:
                 out["gossip_truncated"] = True
             if count:
-                ticks = int(cols[count - 1, 0])
-                msg = _msg64_col(cols[:count])
+                c = cols[:count]
+
+                def col(name: str) -> np.ndarray:
+                    return c[:, GCOL[name]]
+
+                ticks = int(col("tick")[-1])
+                msg = _msg64_col(c)
                 out["sim_ticks"] = ticks
                 out["total_message"] = int(msg[-1])
                 if execute > 0:
@@ -305,28 +321,32 @@ class TelemetryReport:
                         self.n * ticks / execute, 1)
                     out["messages_per_sec"] = round(int(msg[-1]) / execute, 1)
                 per = {
-                    "tick": cols[:count, 0].tolist(),
-                    "received": cols[:count, 1].tolist(),
+                    "tick": col("tick").tolist(),
+                    "received": col("received").tolist(),
                     "message": [int(v) for v in msg],
-                    "crashed": cols[:count, 4].tolist(),
-                    "removed": cols[:count, 5].tolist(),
-                    "mail_high": cols[:count, 6].tolist(),
-                    "dropped": cols[:count, 7].tolist(),
-                    "overflow": cols[:count, 8].tolist(),
+                    "crashed": col("crashed").tolist(),
+                    "removed": col("removed").tolist(),
+                    "mail_high": col("mail_high").tolist(),
+                    "dropped": col("dropped").tolist(),
+                    "overflow": col("overflow").tolist(),
                 }
-                if cols.shape[1] > 12 and bool(cols[:count, 9:13].any()):
+                scen = ("scen_crashed", "recovered", "repaired",
+                        "part_dropped")
+                have = cols.shape[1] > max(GCOL[s] for s in scen)
+                if have and bool(np.stack([col(s) for s in scen]).any()):
                     # Scenario columns only when a scenario actually ran
                     # (all-zero columns would bloat every record).
-                    per["scen_crashed"] = cols[:count, 9].tolist()
-                    per["scen_recovered"] = cols[:count, 10].tolist()
-                    per["heal_repaired"] = cols[:count, 11].tolist()
-                    per["part_dropped"] = cols[:count, 12].tolist()
-                if cols.shape[1] > 13 and bool(cols[:count, 13].any()):
+                    per["scen_crashed"] = col("scen_crashed").tolist()
+                    per["scen_recovered"] = col("recovered").tolist()
+                    per["heal_repaired"] = col("repaired").tolist()
+                    per["part_dropped"] = col("part_dropped").tolist()
+                if (cols.shape[1] > GCOL["rumors_done"]
+                        and bool(col("rumors_done").any())):
                     # Multi-rumor column only when rumors completed.
-                    per["rumors_done"] = cols[:count, 13].tolist()
+                    per["rumors_done"] = col("rumors_done").tolist()
                 out["per_window"] = per
                 out["deltas"] = {
-                    "received": np.diff(cols[:count, 1],
+                    "received": np.diff(col("received"),
                                         prepend=0).tolist(),
                     "message": np.diff(msg.astype(np.int64),
                                        prepend=np.int64(0)).tolist(),

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Bench trajectory regression check (CI tier-1 companion).
+
+Runs the deterministic CPU-scale capture set (`bench.cpu_scale_rows`)
+fresh and compares the trajectory-derived fields -- ticks, coverage,
+total_message, converged, windows, mailbox high-water, rumors done --
+EXACTLY against the committed baseline (BENCH_CPU_BASELINE.json at the
+repo root).  These fields are pure functions of (code, seed) on any
+host, so a delta is a changed simulation trajectory, not noise; wall
+timings are reported informationally and never compared.
+
+    python scripts/check_bench.py            # compare against baseline
+    python scripts/check_bench.py --update   # regenerate the baseline
+
+Exit codes: 0 match, 1 divergence (names row + field + both values),
+2 missing/invalid baseline (run --update first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+# Pin CPU before jax loads (same contract as tests/conftest.py): the
+# baseline is a CPU-trajectory pin and must not grab an attached TPU.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+BASELINE = os.path.join(REPO, "BENCH_CPU_BASELINE.json")
+
+# The exact-match field set.  Every one is an integer count or a ratio
+# of integer counts from the simulated trajectory.
+EXACT_FIELDS = ("n", "backend", "ticks", "coverage", "total_message",
+                "converged", "windows", "mail_high_water",
+                "rumors", "rumors_done", "rumor_min_recv")
+
+
+def _capture(seed: int) -> dict:
+    import bench
+
+    rows = {}
+    for name, cfg in bench.cpu_scale_rows(seed):
+        t0 = time.perf_counter()
+        with bench._named_row(name):
+            out = bench._bench_backend(cfg)
+        rows[name] = {k: out[k] for k in EXACT_FIELDS if k in out}
+        print(f"  {name}: ticks={out['ticks']} "
+              f"msgs={out['total_message']} "
+              f"({time.perf_counter() - t0:.1f}s wall)", flush=True)
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0,
+                   help="capture seed (must match the committed baseline)")
+    p.add_argument("--update", action="store_true",
+                   help="regenerate BENCH_CPU_BASELINE.json from this host")
+    args = p.parse_args(argv)
+
+    print(f"capturing CPU-scale rows (seed {args.seed}) ...", flush=True)
+    rows = _capture(args.seed)
+
+    if args.update:
+        doc = {"seed": args.seed, "rows": rows}
+        with open(BASELINE, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {BASELINE} ({len(rows)} rows)")
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(f"ERROR: {BASELINE} missing -- run with --update to create it")
+        return 2
+    with open(BASELINE) as f:
+        base = json.load(f)
+    if base.get("seed") != args.seed:
+        print(f"ERROR: baseline seed {base.get('seed')} != --seed "
+              f"{args.seed}")
+        return 2
+
+    ok = True
+    for name, want in base["rows"].items():
+        got = rows.get(name)
+        if got is None:
+            print(f"FAIL: row {name} in baseline but not captured "
+                  "(cpu_scale_rows changed? --update the baseline)")
+            ok = False
+            continue
+        for field in sorted(set(want) | set(got)):
+            if want.get(field) != got.get(field):
+                print(f"FAIL: {name}.{field}: baseline {want.get(field)} "
+                      f"vs fresh {got.get(field)}")
+                ok = False
+    for name in rows:
+        if name not in base["rows"]:
+            print(f"FAIL: new row {name} not in baseline (--update it)")
+            ok = False
+    if ok:
+        print(f"OK: {len(rows)} rows match the committed baseline exactly")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,11 +75,35 @@ def main() -> int:
             print("FAIL: JSONL streams differ")
             ok = False
         events = [r["event"] for r in fast]
-        for required in ("params", "overlay", "coverage", "done", "totals",
-                         "result", "telemetry"):
+        for required in ("header", "params", "overlay", "coverage", "done",
+                         "totals", "result", "telemetry"):
             if required not in events:
                 print(f"FAIL: fast JSONL missing event={required!r}")
                 ok = False
+        # Schema v3: the stream opens with the named-column header and it
+        # must match the code's column tables exactly (a drifted header
+        # means npz/JSONL consumers are reading the wrong columns).
+        sys.path.insert(0, REPO)
+        from gossip_simulator_tpu.utils.artifact import TRAJECTORY_COLS
+        from gossip_simulator_tpu.utils.metrics import SCHEMA_VERSION
+        from gossip_simulator_tpu.utils.telemetry import (GOSSIP_COLS,
+                                                          OVERLAY_COLS)
+        if fast and fast[0]["event"] == "header":
+            head = fast[0]
+            want = {"gossip": list(GOSSIP_COLS),
+                    "overlay": list(OVERLAY_COLS),
+                    "trajectory": list(TRAJECTORY_COLS)}
+            if head.get("columns") != want:
+                print(f"FAIL: header columns {head.get('columns')} != "
+                      f"{want}")
+                ok = False
+            if head.get("schema_version") != SCHEMA_VERSION:
+                print(f"FAIL: header schema_version "
+                      f"{head.get('schema_version')} != {SCHEMA_VERSION}")
+                ok = False
+        else:
+            print("FAIL: JSONL stream does not open with the v3 header")
+            ok = False
         if ok:
             t = [r for r in fast if r["event"] == "telemetry"][0]
             print("OK: stdout byte-identical, "

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Structural diff of two `-run-dir` artifacts (see utils/artifact.py).
+
+    python scripts/compare_runs.py RUN_A RUN_B [--timing-tolerance 0.25]
+                                               [--strict-timing]
+
+Answers the regression question in CI-consumable form:
+
+  * trajectory fingerprint equality (the headline bit-identity check),
+  * on mismatch, the FIRST divergent telemetry window -- named row index
+    plus the differing columns by name with both values,
+  * final-Stats deltas from result.json (any delta = divergence),
+  * resolved-gate set differences (a gate flip explains a trajectory
+    delta before the code is suspect),
+  * phase wall-time ratios against a tolerance band -- informational by
+    default, failing only under --strict-timing (wall clocks are noisy).
+
+Exit codes: 0 identical trajectories, 1 divergence, 2 artifact error
+(missing/unreadable run dir).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gossip_simulator_tpu.utils.artifact import (TRAJECTORY_COLS,  # noqa: E402
+                                                 load_run)
+
+# Deterministic result.json fields: any delta here is a divergence.
+STAT_FIELDS = ("round", "coverage", "converged", "reason",
+               "stabilize_ms", "coverage_ms",
+               "overlay_windows", "gossip_windows",
+               "total_received", "total_message", "total_crashed",
+               "total_removed", "makeups", "breakups", "mailbox_dropped",
+               "exchange_overflow", "scen_crashed", "scen_recovered",
+               "part_dropped", "heal_repaired", "exhausted",
+               "rumors", "rumors_done", "fingerprint",
+               "fingerprint_windows")
+
+
+def _first_divergent_window(ta, tb) -> list[str]:
+    """Name the first row where the two canonical trajectories differ,
+    and the differing columns within it."""
+    lines = []
+    if ta is None or tb is None:
+        missing = "A" if ta is None else "B"
+        lines.append(f"  run {missing} has no trajectory array "
+                     "(telemetry.npz absent or empty)")
+        return lines
+    n = min(len(ta), len(tb))
+    for w in range(n):
+        if (ta[w] != tb[w]).any():
+            cols = [f"{name} {int(ta[w][i])} vs {int(tb[w][i])}"
+                    for i, name in enumerate(TRAJECTORY_COLS)
+                    if ta[w][i] != tb[w][i]]
+            lines.append(f"  first divergent window: {w} "
+                         f"({'; '.join(cols)})")
+            return lines
+    if len(ta) != len(tb):
+        lines.append(f"  trajectories share the first {n} windows but "
+                     f"differ in length ({len(ta)} vs {len(tb)} windows)")
+    return lines
+
+
+def compare(a: dict, b: dict, timing_tolerance: float,
+            strict_timing: bool) -> int:
+    """Print the diff; return the exit code."""
+    ra, rb = a["result"], b["result"]
+    diverged = False
+
+    fa = ra.get("fingerprint")
+    fb = rb.get("fingerprint")
+    if fa == fb and fa is not None:
+        print(f"fingerprint: MATCH {fa} "
+              f"(basis {ra.get('fingerprint_basis')})")
+    else:
+        diverged = True
+        print(f"fingerprint: DIVERGED {fa} vs {fb}")
+        for line in _first_divergent_window(
+                a["telemetry"].get("trajectory"),
+                b["telemetry"].get("trajectory")):
+            print(line)
+
+    for field in STAT_FIELDS:
+        va, vb = ra.get(field), rb.get(field)
+        if va != vb:
+            diverged = True
+            print(f"result.{field}: {va} vs {vb}")
+    ba, bb = ra.get("fingerprint_basis"), rb.get("fingerprint_basis")
+    if ba != bb:
+        # A path difference (telemetry fast path vs windowed loop), not a
+        # trajectory difference -- the fingerprint itself already proves
+        # the two bases agree row-for-row.
+        print(f"fingerprint basis: {ba} vs {bb} (informational)")
+
+    ga = a["config"].get("resolved", {})
+    gb = b["config"].get("resolved", {})
+    for key in sorted(set(ga) | set(gb)):
+        if ga.get(key) != gb.get(key):
+            # Not a divergence by itself, but the first place to look
+            # when the trajectory diverged.
+            print(f"gate {key}: {ga.get(key)} vs {gb.get(key)} "
+                  "(config difference)")
+
+    pa = ra.get("phases_s") or {}
+    pb = rb.get("phases_s") or {}
+    for phase in sorted(set(pa) & set(pb)):
+        va, vb = float(pa[phase]), float(pb[phase])
+        base = max(va, 1e-9)
+        ratio = vb / base
+        if abs(ratio - 1.0) > timing_tolerance:
+            tag = "FAIL" if strict_timing else "note"
+            print(f"timing {phase}: {va:.3f}s vs {vb:.3f}s "
+                  f"(ratio {ratio:.2f}, tolerance "
+                  f"{1 - timing_tolerance:.2f}..{1 + timing_tolerance:.2f}) "
+                  f"[{tag}]")
+            if strict_timing:
+                diverged = True
+
+    if not diverged:
+        print("OK: runs are trajectory-identical")
+    return 1 if diverged else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run_a", help="baseline run dir")
+    p.add_argument("run_b", help="candidate run dir")
+    p.add_argument("--timing-tolerance", type=float, default=0.25,
+                   help="allowed per-phase wall-time ratio deviation "
+                        "(default 0.25 = +/-25%%)")
+    p.add_argument("--strict-timing", action="store_true",
+                   help="timing-band violations fail the comparison "
+                        "(default: informational)")
+    args = p.parse_args(argv)
+    try:
+        a = load_run(args.run_a)
+        b = load_run(args.run_b)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        print(f"ERROR: {e}")
+        return 2
+    print(f"A: {a['path']}\nB: {b['path']}")
+    return compare(a, b, args.timing_tolerance, args.strict_timing)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,7 +156,8 @@ def test_printer_context_manager_closes_on_exception(tmp_path):
         pass
     assert printer._jsonl is None  # closed by __exit__
     recs = [json.loads(line) for line in p.read_text().splitlines()]
-    assert recs and recs[0]["event"] == "section"
+    # v3: the lazily-written column header precedes the first real record.
+    assert [r["event"] for r in recs] == ["header", "section"]
 
 
 def test_telemetry_off_quiet_run_unchanged(tmp_path):
