@@ -683,6 +683,55 @@ def capture_multirumor(detail: dict, seed: int,
         detail[name] = row
 
 
+def capture_serve_elasticity(detail: dict, seed: int) -> None:
+    """Elastic serving row (ISSUE 11): the CI twin shape forced through
+    one widen and one narrow, measuring reshard_pause_ms -- the wall-clock
+    the service stood still across checkpoint -> rebuild -> restore, the
+    SLO cost a future perf round drives down -- with the zero-loss
+    invariant (shed == 0, every rumor delivered) asserted in the row
+    itself.  Needs >= 2 devices to widen onto; single-device hosts record
+    a named skip (CI runs the full twin on the 8-fake-device shim)."""
+    devs = len(jax.devices())
+    if devs < 2:
+        detail["serve_elasticity"] = {
+            "skipped": f"needs >= 2 devices to reshard, host has {devs} "
+                       "(tier-1 runs the twin on the 8-fake-device shim)"}
+        return
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    import tempfile
+
+    wide = 8 if devs >= 8 else 2
+    n = 1_048_576 if jax.default_backend() == "tpu" else 2048
+    t0 = time.perf_counter()
+    try:
+        with tempfile.TemporaryDirectory() as rd:
+            cfg = Config(n=n, graph="kout", fanout=6, seed=seed,
+                         crashrate=0.0, droprate=0.0, delaylow=10,
+                         delayhigh=11, protocol="si", engine="event",
+                         backend="jax", rumors=8, traffic="stream",
+                         stream_rate=40, coverage_target=0.99,
+                         max_rounds=3000, progress=False, serve=True,
+                         serve_force=f"{wide}@4,1@10",
+                         run_dir=rd).validate()
+            res = run_simulation(cfg, printer=ProgressPrinter(enabled=False))
+            with open(os.path.join(rd, "result.json")) as fh:
+                payload = json.load(fh)
+        row = {"n": n, "wide_shards": wide,
+               "converged": res.converged,
+               "rumors_done": res.stats.rumors_done,
+               "shed": res.stats.shed,
+               "resizes": payload["serve"]["resizes"],
+               "reshard_pause_ms": payload["reshard_pause_ms"],
+               "wall_s": round(time.perf_counter() - t0, 3)}
+        if res.stats.shed or res.stats.rumors_done != cfg.rumors:
+            row["error"] = "zero-loss reshard invariant violated"
+    except Exception as e:  # record, don't kill the bench line
+        row = {"error": repr(e)}
+    detail["serve_elasticity"] = row
+
+
 def capture_multirumor_50m(detail: dict, seed: int) -> None:
     """TPU-only 50M twin pair: the single-rumor baseline and the R=16
     concurrent broadcast at the SAME n/graph/seed, so the record carries
@@ -969,6 +1018,9 @@ def main() -> int:
         # Multi-rumor serving rows (ISSUE 8): 1M R=16 oneshot + streaming
         # injection, scale-banded the same way.
         capture_multirumor(result["detail"], args.seed)
+        # Elastic serving row (ISSUE 11): forced widen+narrow reshard
+        # pause + zero-loss invariant (skipped on single-device hosts).
+        capture_serve_elasticity(result["detail"], args.seed)
         if jax.default_backend() == "tpu":
             # Distributional validation of the Pallas generators on real
             # hardware (interpret-mode CI can only check structure); also

@@ -177,6 +177,13 @@ def run_bounded_to_target(stepper) -> Stats:
         if (recv >= target or tick >= cfg.max_rounds
                 or stepper.exhausted):
             break
+        # Cooperative shutdown (utils/lifecycle): a signalled run stops at
+        # the next bounded-call boundary; the driver then writes the final
+        # checkpoint and flushes artifacts with reason "interrupted".
+        from gossip_simulator_tpu.utils import lifecycle as _lifecycle
+
+        if _lifecycle.shutdown_requested():
+            break
     if telem is not None:
         telem.end_gossip(hist)
     return stepper.stats()

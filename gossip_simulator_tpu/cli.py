@@ -32,6 +32,14 @@ def _maybe_reexec_for_cpu(argv: Optional[list[str]]) -> None:
 
 def main(argv: Optional[list[str]] = None) -> int:
     cfg = parse_args(argv)
+    # Graceful shutdown (utils/lifecycle): the first SIGTERM/SIGINT turns
+    # into a final atomic checkpoint + artifact flush with reason
+    # "interrupted" (exit 2, the standard not-converged code); a second
+    # signal kills the process the default way.  Installed for every run,
+    # not just -serve -- any long batch run deserves the same exit.
+    from gossip_simulator_tpu.utils import lifecycle
+
+    lifecycle.install_signal_handlers()
     silent = False
     if cfg.backend in ("jax", "sharded"):
         _maybe_reexec_for_cpu(argv)

@@ -294,6 +294,45 @@ class Config:
     # r is injected at tick r * 1000 // stream_rate at a derived-key uniform
     # source, shard-count invariantly.
     stream_rate: int = 100
+    # --- elastic serving mode (ISSUE 11; gossip_simulator_tpu/serve.py) ------
+    # Long-lived serving loop: watches mail-ring occupancy against the
+    # watermarks below and reshards (checkpoint -> wider/narrower mesh ->
+    # resume) without dropping in-flight rumors.  Requires -traffic stream
+    # on the event engine (jax/sharded backends).
+    serve: bool = False
+    # Arrival process shaping the per-rumor injection schedule (stream
+    # traffic): "fixed" keeps the analytic r * 1000 // stream_rate ladder
+    # (bit-identical to the pre-serve build); "poisson" draws exponential
+    # inter-arrivals with mean 1000/stream_rate; "burst" releases groups
+    # of 8 rumors at group boundaries; "diurnal" modulates the rate with a
+    # sinusoidal load curve.  All schedules are precomputed host-side from
+    # (arrivals, stream_rate, rumors, seed) alone -- keyed by rumor index,
+    # so they are shard-count invariant and reshard-resume safe.
+    arrivals: str = "fixed"
+    # Autoscaler watermarks: mail-ring occupancy fractions (high-water
+    # entries / slot capacity).  serve_window consecutive windows above
+    # serve_high trip a widen; the same below serve_low trip a narrow.
+    serve_high: float = 0.85
+    serve_low: float = 0.10
+    serve_window: int = 3
+    # Shard-count band for the autoscaler.  -1 = all visible devices.
+    serve_min_shards: int = 1
+    serve_max_shards: int = -1
+    # Deterministic transition override for CI: "S@W[,S@W...]" reshards to
+    # S shards at serve window W regardless of occupancy (e.g. "8@4,1@10"
+    # forces one widen and one narrow).  Empty = telemetry-driven.
+    serve_force: str = ""
+    # Admission-control backoff cap (simulated ms): when the widest mesh
+    # is still saturated, pending injections are deferred by a doubling
+    # backoff capped here, counted in Stats.shed, and never dropped.
+    serve_max_defer: int = 2000
+    # Checkpoint retention: after each successful save keep only the
+    # newest K snapshots (sha256 sidecars pruned with them).  0 = keep all.
+    ckpt_keep: int = 0
+    # Internal: explicit per-rumor injection-tick override (sorted tuple,
+    # len == rumors).  Set by serve's admission control when it defers
+    # pending injections; not a CLI flag.
+    inject_ticks: Optional[tuple] = None
 
     # --- derived --------------------------------------------------------------
     @property
@@ -405,9 +444,16 @@ class Config:
     @property
     def last_inject_tick(self) -> int:
         """Tick of the final rumor's injection under stream traffic
-        (rumor r enters at r * 1000 // stream_rate); 0 for oneshot."""
+        (rumor r enters at r * 1000 // stream_rate on the fixed schedule;
+        non-fixed arrivals and serve deferrals consult the precomputed
+        arrival table); 0 for oneshot."""
         if self.traffic != "stream":
             return 0
+        from gossip_simulator_tpu import arrivals as _arrivals
+
+        table = _arrivals.table_or_none(self)
+        if table is not None:
+            return int(table[-1])
         return (self.rumors - 1) * 1000 // max(self.stream_rate, 1)
 
     @property
@@ -748,6 +794,60 @@ class Config:
                 raise ValueError(
                     "-traffic stream requires the event engine (the jitted "
                     "injection schedule rides the event window step)")
+        # --- elastic serving / arrival processes --------------------------
+        if self.arrivals not in ("fixed", "poisson", "burst", "diurnal"):
+            raise ValueError(
+                f"arrivals must be fixed|poisson|burst|diurnal, "
+                f"got {self.arrivals!r}")
+        if self.arrivals != "fixed" and self.traffic != "stream":
+            raise ValueError(
+                "-arrivals shapes the streaming injection schedule; it "
+                "requires -traffic stream")
+        if self.inject_ticks is not None:
+            if self.traffic != "stream":
+                raise ValueError("inject_ticks requires -traffic stream")
+            ticks = self.inject_ticks
+            if len(ticks) != self.rumors:
+                raise ValueError(
+                    f"inject_ticks length ({len(ticks)}) must equal rumors "
+                    f"({self.rumors})")
+            if any(t < 0 or t >= 2**31 - 1 for t in ticks):
+                raise ValueError("inject_ticks entries must be int32 ticks")
+            if any(b < a for a, b in zip(ticks, ticks[1:])):
+                raise ValueError("inject_ticks must be nondecreasing")
+        if self.serve:
+            if self.traffic != "stream":
+                raise ValueError(
+                    "-serve is the streaming service loop; it requires "
+                    "-traffic stream")
+            if self.backend not in ("jax", "sharded"):
+                raise ValueError("-serve requires backend=jax or sharded")
+            if self.resume:
+                raise ValueError(
+                    "-serve manages its own reshard-resume cycle; -resume "
+                    "is not supported with it")
+            if not 0.0 <= self.serve_low < self.serve_high <= 1.0:
+                raise ValueError(
+                    f"need 0 <= serve_low < serve_high <= 1, got "
+                    f"low={self.serve_low} high={self.serve_high}")
+            if self.serve_window < 1:
+                raise ValueError(
+                    f"serve_window must be >= 1, got {self.serve_window}")
+            if self.serve_min_shards < 1:
+                raise ValueError(
+                    f"serve_min_shards must be >= 1, "
+                    f"got {self.serve_min_shards}")
+            if (self.serve_max_shards != -1
+                    and self.serve_max_shards < self.serve_min_shards):
+                raise ValueError(
+                    "serve_max_shards must be -1 (all devices) or >= "
+                    "serve_min_shards")
+            if self.serve_max_defer < 0:
+                raise ValueError(
+                    f"serve_max_defer must be >= 0, got {self.serve_max_defer}")
+            parse_serve_force(self.serve_force)  # raises on a bad spec
+        if self.ckpt_keep < 0:
+            raise ValueError(f"ckpt_keep must be >= 0, got {self.ckpt_keep}")
         # --- fault-injection scenario ------------------------------------
         scen = self.scenario_resolved  # raises ValueError on a bad spec
         if scen.active:
@@ -862,6 +962,29 @@ class Config:
         lines = ["=== Parameters ==="]
         lines += [f"{k}={v}" for k, v in sorted(ref.items())]
         return "\n".join(lines)
+
+
+def parse_serve_force(spec: str) -> dict:
+    """Parse a `-serve-force` spec "S@W[,S@W...]" into {window: shards}.
+    Raises ValueError on malformed entries."""
+    out: dict = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        try:
+            s_str, w_str = part.strip().split("@")
+            s, w = int(s_str), int(w_str)
+        except ValueError:
+            raise ValueError(
+                f"bad -serve-force entry {part!r} (expected S@W, e.g. 8@4)")
+        if s < 1 or w < 0:
+            raise ValueError(
+                f"-serve-force entry {part!r}: need shards >= 1, window >= 0")
+        if w in out:
+            raise ValueError(
+                f"-serve-force window {w} given twice")
+        out[w] = s
+    return out
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -982,6 +1105,45 @@ def _build_parser() -> argparse.ArgumentParser:
                    type=int, default=d.stream_rate,
                    help="stream traffic injection rate, rumors per 1000 "
                         "simulated ms")
+    p.add_argument("-serve", "--serve", action="store_true",
+                   help="elastic serving loop: autoscale the shard count "
+                        "under streaming traffic via checkpoint -> reshard "
+                        "-> resume, with admission control when saturated")
+    p.add_argument("-arrivals", "--arrivals",
+                   choices=("fixed", "poisson", "burst", "diurnal"),
+                   default=d.arrivals,
+                   help="stream arrival process: fixed analytic ladder, "
+                        "poisson inter-arrivals, 8-rumor bursts, or a "
+                        "sinusoidal diurnal curve (all deterministic per "
+                        "rumor index, shard-count invariant)")
+    p.add_argument("-serve-high", "--serve-high", dest="serve_high",
+                   type=float, default=d.serve_high,
+                   help="widen watermark: mail-ring occupancy fraction")
+    p.add_argument("-serve-low", "--serve-low", dest="serve_low",
+                   type=float, default=d.serve_low,
+                   help="narrow watermark: mail-ring occupancy fraction")
+    p.add_argument("-serve-window", "--serve-window", dest="serve_window",
+                   type=int, default=d.serve_window,
+                   help="consecutive windows beyond a watermark before the "
+                        "autoscaler acts")
+    p.add_argument("-serve-min-shards", "--serve-min-shards",
+                   dest="serve_min_shards", type=int,
+                   default=d.serve_min_shards)
+    p.add_argument("-serve-max-shards", "--serve-max-shards",
+                   dest="serve_max_shards", type=int,
+                   default=d.serve_max_shards,
+                   help="autoscaler shard-count ceiling (-1 = all devices)")
+    p.add_argument("-serve-force", "--serve-force", dest="serve_force",
+                   default=d.serve_force,
+                   help="deterministic reshard override 'S@W[,S@W...]': "
+                        "reshard to S shards at serve window W (CI twins)")
+    p.add_argument("-serve-max-defer", "--serve-max-defer",
+                   dest="serve_max_defer", type=int, default=d.serve_max_defer,
+                   help="admission-control backoff cap in simulated ms")
+    p.add_argument("-ckpt-keep", "--ckpt-keep", dest="ckpt_keep", type=int,
+                   default=d.ckpt_keep,
+                   help="keep only the newest K checkpoint snapshots after "
+                        "each successful save (0 = keep all)")
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

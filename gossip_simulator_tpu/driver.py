@@ -17,6 +17,7 @@ from typing import Optional
 from gossip_simulator_tpu.backends import make_stepper
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.utils import lifecycle as _lifecycle
 from gossip_simulator_tpu.utils import telemetry as _telemetry
 from gossip_simulator_tpu.utils import trace as _trace
 from gossip_simulator_tpu.utils.metrics import ProgressPrinter, Stats
@@ -135,6 +136,11 @@ def _run(cfg: Config, printer: ProgressPrinter,
             resumed = True
 
     # --- Phase 1: overlay (simulator.go:219-235) ------------------------------
+    # Cooperative-shutdown bookkeeping (utils/lifecycle): a signalled run
+    # finishes its current window, saves a final checkpoint and flushes
+    # artifacts with reason "interrupted".  `p1_interrupted` marks a run
+    # that never reached phase 2 (no epidemic state to report or seed).
+    p1_interrupted = False
     if not resumed:
         printer.section("Constructing Overlay")
         if (cfg.graph == "overlay" and cfg.overlay_mode == "auto"
@@ -196,18 +202,22 @@ def _run(cfg: Config, printer: ProgressPrinter,
                 printer.overlay_window(breakups, makeups,
                                        stepper.sim_time_ms())
                 ckpt1.maybe_save_overlay(overlay_windows)
+                if _lifecycle.shutdown_requested():
+                    p1_interrupted = True
+                    break
                 if overlay_windows >= max_overlay_windows:
                     raise RuntimeError(
                         f"overlay did not stabilize within "
                         f"{max_overlay_windows} windows")
     stabilize_ms = 0.0 if resumed else stepper.sim_time_ms()
-    if not resumed:
+    if not resumed and not p1_interrupted:
         printer.stabilized(stabilize_ms)
 
     # --- Phase 2: broadcast (simulator.go:237-253) ----------------------------
-    printer.section("Broadcast one message")
-    if not resumed:
-        stepper.seed()
+    if not p1_interrupted:
+        printer.section("Broadcast one message")
+        if not resumed:
+            stepper.seed()
     target = cfg.coverage_target
     window_rounds = WINDOW_MS if cfg.effective_time_mode == "ticks" else 1
     # max_rounds caps simulated time at WINDOW granularity (both this loop
@@ -239,8 +249,34 @@ def _run(cfg: Config, printer: ProgressPrinter,
     # Stats.round IS the recorded tick, so the two bases are identical).
     window_rows: list = []
     collect_rows = bool(cfg.run_dir) and not printer.silent
+    # Serve mode rebuilds the stepper across reshards, so the live config
+    # (admission deferrals mutate the injection schedule) and the stepper
+    # the final stats come from both ride the ServeOutcome.
+    live_cfg = cfg
+    serve_report = None
+    interrupted = p1_interrupted
     with _maybe_profile(cfg):
-        if fast:
+        if p1_interrupted:
+            pass
+        elif cfg.serve:
+            from gossip_simulator_tpu import serve as _serve
+
+            outcome = _serve.run_serve(cfg, stepper, printer, max_windows,
+                                       resume_window=resume_window,
+                                       collect_rows=collect_rows)
+            stepper = outcome.stepper
+            live_cfg = outcome.cfg
+            gossip_windows = outcome.windows
+            converged = outcome.converged
+            window_rows = outcome.rows
+            serve_report = outcome.report
+            interrupted = interrupted or outcome.interrupted
+            # Device-recorded telemetry histories do not survive a reshard
+            # (each incarnation starts its own); the artifact trajectory
+            # uses the host-collected rows instead (basis "windows" --
+            # row-identical to a twin's telemetry basis).
+            telem = None
+        elif fast:
             with _trace.span("phase2.run_to_target", cat="phase") as sp:
                 stats = stepper.run_to_target()
                 if sp is not None:
@@ -254,6 +290,8 @@ def _run(cfg: Config, printer: ProgressPrinter,
                               if hist2 and not hist2["truncated"]
                               else -(-stats.round // window_rounds))
             converged = stats.coverage >= target
+            if _lifecycle.shutdown_requested():
+                interrupted = True
         else:
             while gossip_windows < max_windows:
                 with _trace.span("phase2.window", cat="window") as sp:
@@ -280,15 +318,30 @@ def _run(cfg: Config, printer: ProgressPrinter,
                     break
                 if getattr(stepper, "exhausted", False):
                     break  # no messages in flight and nothing can change
-    coverage_ms = stepper.sim_time_ms()
-    stats = stepper.stats()
+                if _lifecycle.shutdown_requested():
+                    interrupted = True
+                    break
+    # A run interrupted mid-overlay has no epidemic state to read back.
+    coverage_ms = 0.0 if p1_interrupted else stepper.sim_time_ms()
+    stats = Stats(n=cfg.n) if p1_interrupted else stepper.stats()
+    if serve_report is not None:
+        stats.shed = serve_report["shed"]
     # A snapshot restored at/after the cap may already be at target.
     converged = converged or stats.coverage >= target
     # The true cause rides Stats now (threaded by every backend), so both
     # paths -- and the replayed fast path -- report "exhausted" whenever
-    # the wave died, even in the window the round cap was hit.
-    reason = ("exhausted: no messages in flight"
-              if stats.exhausted else "max rounds")
+    # the wave died, even in the window the round cap was hit.  A signalled
+    # run reports "interrupted" whatever else was true -- the exit is the
+    # signal's doing, and the final checkpoint below makes it resumable.
+    if interrupted:
+        reason = "interrupted"
+    else:
+        reason = ("exhausted: no messages in flight"
+                  if stats.exhausted else "max rounds")
+    if interrupted and cfg.checkpoint_dir:
+        _final_shutdown_checkpoint(cfg, stepper, stats, p1_interrupted,
+                                   resume_window + gossip_windows,
+                                   overlay_windows)
     printer.done(coverage_ms, stats, target_pct=target * 100.0,
                  converged=converged, reason=reason)
     result = RunResult(
@@ -313,8 +366,15 @@ def _run(cfg: Config, printer: ProgressPrinter,
         "gates": cfg.resolved_gates(),
         **stats.to_dict(),
     }
-    if cfg.multi_rumor:
-        payload.update(_multi_rumor_report(cfg, stepper, stats,
+    if serve_report is not None:
+        payload["reshard_pause_ms"] = serve_report["reshard_pause_ms"]
+        payload["serve"] = {k: serve_report[k] for k in
+                            ("arrivals", "final_shards", "resizes",
+                             "reshard_pause_ms", "shed")}
+    if cfg.multi_rumor and not p1_interrupted:
+        # live_cfg, not cfg: admission deferrals rewrite the injection
+        # schedule, and latency is measured against what actually ran.
+        payload.update(_multi_rumor_report(live_cfg, stepper, stats,
                                            coverage_ms))
     if telem is not None:
         payload["phases_s"] = {k: round(v, 6)
@@ -330,12 +390,39 @@ def _run(cfg: Config, printer: ProgressPrinter,
         if cfg.telemetry_summary:
             printer.block(report.summary_block())
     if cfg.run_dir and not printer.silent:
-        _write_run_dir(cfg, telem, window_rows, payload, stats)
+        _write_run_dir(cfg, telem, window_rows, payload, stats,
+                       serve_report)
     return result
 
 
+def _final_shutdown_checkpoint(cfg: Config, stepper: Stepper, stats: Stats,
+                               phase1: bool, window: int,
+                               overlay_windows: int) -> None:
+    """The signal path's final atomic save (ISSUE 11 satellite 1): whatever
+    phase the run was in, its furthest state lands on disk before the
+    "interrupted" result goes out, so `-resume` continues where the signal
+    struck.  Collective like every snapshot; pruned like every save."""
+    from gossip_simulator_tpu.utils import checkpoint
+
+    if phase1:
+        tree = stepper.overlay_state_pytree()
+        if tree is not None and stepper.primary_host:
+            checkpoint.save(cfg.checkpoint_dir, overlay_windows, tree,
+                            Stats(n=cfg.n), prefix="overlay",
+                            extra_meta={"phase": 1, "interrupted": True,
+                                        "sim_ms": stepper.sim_time_ms()})
+            checkpoint.prune(cfg.checkpoint_dir, cfg.ckpt_keep,
+                             prefix="overlay")
+    else:
+        tree = stepper.state_pytree()
+        if tree is not None and stepper.primary_host:
+            checkpoint.save(cfg.checkpoint_dir, window, tree, stats,
+                            extra_meta={"interrupted": True})
+            checkpoint.prune(cfg.checkpoint_dir, cfg.ckpt_keep)
+
+
 def _write_run_dir(cfg: Config, telem, window_rows: list, payload: dict,
-                   stats: Stats) -> None:
+                   stats: Stats, serve_report: Optional[dict] = None) -> None:
     """Flush the `-run-dir` artifact (utils/artifact.py layout).  The
     trajectory prefers the device-recorded history (fast path), falls
     back to the windowed loop's host-collected rows, and degrades to a
@@ -360,6 +447,8 @@ def _write_run_dir(cfg: Config, telem, window_rows: list, payload: dict,
     rdir.write_config(cfg)
     rdir.write_env()
     rdir.write_telemetry(hist_o, hist_g, traj)
+    if serve_report is not None:
+        rdir.write_serve(serve_report)
     rdir.write_result({
         **payload,
         "fingerprint": artifact.fingerprint_rows(traj),
@@ -368,20 +457,40 @@ def _write_run_dir(cfg: Config, telem, window_rows: list, payload: dict,
     })
 
 
+def latency_summary(lat) -> dict:
+    """Interpolated per-rumor latency summary (the SLO block).  np.percentile
+    linear interpolation between order statistics -- NOT histogram-bucket
+    upper edges, which overstated p50 by up to a full bucket width at small
+    R -- with the exact min/max/mean alongside."""
+    import numpy as np
+
+    a = np.asarray(lat, np.int64)
+    p50, p90, p99 = np.percentile(a, [50, 90, 99])
+    return {
+        "min": int(a.min()), "max": int(a.max()),
+        "p50": round(float(p50), 2),
+        "p90": round(float(p90), 2),
+        "p99": round(float(p99), 2),
+        "mean": round(float(a.mean()), 2),
+    }
+
+
 def _multi_rumor_report(cfg: Config, stepper: Stepper, stats: Stats,
                         coverage_ms: float) -> dict:
     """Steady-state serving metrics for the terminal `result` record
     (simulated-time domain; wall-clock throughput lives in the telemetry
     report).  Per-rumor latency = rumor_done stamp minus the ANALYTIC
-    inject tick (rumor r starts at r * 1000 // stream_rate under
-    -traffic stream, tick 0 under oneshot) -- the schedule is
-    deterministic, so no per-rumor start stamp is carried on device."""
+    inject tick (arrivals.arrival_ticks under -traffic stream, tick 0
+    under oneshot) -- the schedule is deterministic, so no per-rumor
+    start stamp is carried on device."""
     import jax
     import numpy as np
 
+    from gossip_simulator_tpu import arrivals as _arrivals
+
     R = cfg.rumors
     done = np.asarray(jax.device_get(stepper.state.rumor_done))[:R]
-    inject = (np.arange(R, dtype=np.int64) * 1000 // cfg.stream_rate
+    inject = (np.asarray(_arrivals.arrival_ticks(cfg), np.int64)
               if cfg.traffic == "stream" else np.zeros(R, np.int64))
     out: dict = {"traffic": cfg.traffic}
     secs = coverage_ms / 1000.0
@@ -390,12 +499,7 @@ def _multi_rumor_report(cfg: Config, stepper: Stepper, stats: Stats,
         out["deliveries_per_sec"] = round(stats.total_message / secs, 1)
     lat = (done.astype(np.int64) - inject)[done >= 0]
     if lat.size:
-        out["rumor_latency_ms"] = {
-            "min": int(lat.min()), "max": int(lat.max()),
-            "p50": int(np.percentile(lat, 50)),
-            "p90": int(np.percentile(lat, 90)),
-            "mean": round(float(lat.mean()), 2),
-        }
+        out["rumor_latency_ms"] = latency_summary(lat)
         counts, edges = np.histogram(lat, bins=min(10, max(1, lat.size)))
         out["rumor_latency_hist"] = {
             "edges_ms": [round(float(e), 1) for e in edges],
@@ -423,6 +527,7 @@ class _Checkpointer:
         tree = self.stepper.state_pytree()
         if tree is not None and self.stepper.primary_host:
             checkpoint.save(self.cfg.checkpoint_dir, window, tree, stats)
+            checkpoint.prune(self.cfg.checkpoint_dir, self.cfg.ckpt_keep)
 
     def maybe_save_overlay(self, window: int) -> None:
         """Phase-1 snapshot on the same cadence (VERDICT r3 weak #6: a
@@ -443,6 +548,8 @@ class _Checkpointer:
                 Stats(n=self.cfg.n), prefix="overlay",
                 extra_meta={"phase": 1,
                             "sim_ms": self.stepper.sim_time_ms()})
+            checkpoint.prune(self.cfg.checkpoint_dir, self.cfg.ckpt_keep,
+                             prefix="overlay")
 
 
 @contextlib.contextmanager

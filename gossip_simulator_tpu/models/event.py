@@ -70,6 +70,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from gossip_simulator_tpu import scenario as _scen
 from gossip_simulator_tpu.config import Config
@@ -321,6 +322,15 @@ def injection_lanes(cfg: Config) -> int:
     if cfg.traffic != "stream":
         return cfg.rumors
     b = batch_ticks(cfg)
+    from gossip_simulator_tpu import arrivals as _arrivals
+
+    table = _arrivals.table_or_none(cfg)
+    if table is not None:
+        # Windows are b-aligned (tick advances 0, b, 2b, ...), so the max
+        # rumors per aligned bucket is the exact lane requirement.
+        counts = np.unique(np.asarray(table, np.int64) // b,
+                           return_counts=True)[1]
+        return int(counts.max()) if len(counts) else 1
     return min(cfg.rumors, (b * cfg.stream_rate + 999) // 1000 + 1)
 
 
@@ -342,6 +352,22 @@ def injection_batch(cfg: Config, tick, base_key, b: int, dw: int,
     w = cfg.rumor_word_count
     stream = cfg.traffic == "stream"
     if stream:
+        from gossip_simulator_tpu import arrivals as _arrivals
+
+        table = _arrivals.table_or_none(cfg)
+    else:
+        table = None
+    if table is not None:
+        # Precomputed arrival schedule (non-fixed -arrivals, or a serve
+        # admission-deferral override): the sorted table is a compile-time
+        # constant (R <= 1024 int32s), so the window's first candidate
+        # rumor is a searchsorted lookup and its tick a gather.  Same lane
+        # validity/payload math as the arithmetic branch below.
+        tab = jnp.asarray(table, I32)
+        r0 = jnp.searchsorted(tab, tick, side="left").astype(I32)
+        rr = r0 + jnp.arange(m, dtype=I32)
+        t_r = tab[jnp.minimum(rr, r_total - 1)]
+    elif stream:
         rate = cfg.stream_rate
         # Clamp before the multiply so tick * rate stays in int32 at any
         # max_rounds (past last_inject_tick every lane invalidates anyway;

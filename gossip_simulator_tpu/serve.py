@@ -1,0 +1,345 @@
+"""Elastic serving mode (`-serve`, ISSUE 11): telemetry-driven autoscaling
+under live streaming traffic.
+
+The driver hands this module a seeded stepper and the serve loop takes over
+phase 2: it advances poll windows like the windowed loop, but between
+windows it watches the mail-ring occupancy (high-water entries / slot
+capacity -- the device-resident saturation signal) against the configured
+watermarks and, when one trips for `serve_window` consecutive windows,
+performs **checkpoint -> reshard -> resume**:
+
+  1. snapshot the full state pytree (`state_pytree` -- the PR-4 atomic
+     checkpoint surface; written to disk too when -checkpoint-dir is set),
+  2. build a fresh stepper on the wider/narrower mesh (S=1 uses the
+     single-device jax backend, S>1 the sharded backend over the first S
+     devices),
+  3. restore (`load_state_pytree` -- the PR-5 mid-stream re-bucketing
+     repacks the S_old per-shard mail rings onto S_new shards).
+
+Not a single in-flight rumor is dropped: the snapshot carries the complete
+mail ring, and the injection schedule is a pure function of the config
+(gossip_simulator_tpu/arrivals.py -- keyed by rumor index, shard-count
+invariant), so the rebuilt stepper continues the exact trajectory.  The
+S=1<->S=8 Stats-exactness of this transition is pinned by the reshard
+tests and the CI serve-smoke twin.
+
+**Admission control** is the graceful-degradation path: when the widest
+mesh is still saturated, the not-yet-injected suffix of the arrival table
+is shifted by a doubling backoff (capped at -serve-max-defer) -- rumors
+are *deferred*, counted in `Stats.shed`, and retried; never silently lost.
+The shift rides the same reshard machinery (the schedule is baked into the
+traced window step, so a deferral rebuilds the stepper at the same S with
+the new `inject_ticks` override).
+
+Every decision lands in the autoscaler log (window, tick, action,
+occupancy, pause ms) and the whole transition is a flight-recorder span
+("serve.reshard"), so reshard-pause time -- the metric the next perf PR
+drives toward zero -- is measured, not estimated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossip_simulator_tpu.backends.base import Stepper
+from gossip_simulator_tpu.config import Config, parse_serve_force
+from gossip_simulator_tpu.parallel.mesh import AXIS
+from gossip_simulator_tpu.utils import lifecycle as _lifecycle
+from gossip_simulator_tpu.utils import trace as _trace
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter, Stats
+
+
+@dataclasses.dataclass
+class ServeOutcome:
+    """What the driver needs back: the (possibly rebuilt) stepper, the live
+    config (admission deferrals mutate the injection schedule), the window
+    count/rows for artifacts, and the serve report for result.json."""
+
+    stepper: Stepper
+    cfg: Config
+    windows: int
+    converged: bool
+    interrupted: bool
+    rows: list
+    report: dict
+    shed: int
+
+
+def shard_count(stepper) -> int:
+    mesh = getattr(stepper, "mesh", None)
+    if mesh is None:
+        return 1
+    return int(mesh.shape[AXIS])
+
+
+def next_shard_count(s: int, direction: int, lo: int, hi: int,
+                     n: int) -> int:
+    """The autoscaler's doubling ladder: the nearest power-of-two step in
+    `direction` that stays inside [lo, hi] and divides n (shard_size
+    requires exact divisibility).  Returns s unchanged when no step fits."""
+    nxt = s * 2 if direction > 0 else s // 2
+    while lo <= nxt <= hi:
+        if n % nxt == 0:
+            return nxt
+        nxt = nxt * 2 if direction > 0 else nxt // 2
+    return s
+
+
+def build_stepper(cfg: Config, n_shards: int) -> Stepper:
+    """A fresh ready-to-restore stepper at `n_shards`: init + overlay drain
+    + seed, exactly the reshard-resume pattern the PR-5 tests pin -- the
+    subsequent load_state_pytree overwrites graph and state wholesale."""
+    if n_shards <= 1:
+        from gossip_simulator_tpu.backends.jax_backend import JaxStepper
+
+        stepper: Stepper = JaxStepper(cfg.replace(backend="jax"))
+    else:
+        from gossip_simulator_tpu.backends.sharded import ShardedStepper
+
+        stepper = ShardedStepper(cfg.replace(backend="sharded"),
+                                 n_devices=n_shards)
+    stepper.init()
+    while not stepper.overlay_window()[2]:
+        pass
+    stepper.seed()
+    return stepper
+
+
+def _occupancy(stepper, cfg: Config, n_shards: int) -> float:
+    """Mail-ring occupancy fraction: the fullest window slot's entry count
+    over the per-shard slot capacity -- the backpressure signal (appends
+    beyond the cap are counted drops, so occupancy ~1.0 means loss is
+    imminent)."""
+    state = getattr(stepper, "state", None)
+    cnt = getattr(state, "mail_cnt", None)
+    if cnt is None:
+        return 0.0
+    from gossip_simulator_tpu.models.event import slot_cap
+
+    cap = slot_cap(cfg, max(cfg.n // n_shards, 1))
+    return float(jax.device_get(jnp.max(cnt))) / float(max(cap, 1))
+
+
+def _pending_mask(cfg: Config, current_tick: int) -> np.ndarray:
+    from gossip_simulator_tpu import arrivals as _arrivals
+
+    table = np.asarray(_arrivals.arrival_ticks(cfg), np.int64)
+    return table > current_tick
+
+
+def defer_pending(cfg: Config, current_tick: int, backoff_ms: int
+                  ) -> tuple[int, Config, int]:
+    """Admission control: shift every not-yet-injected arrival by one
+    backoff step (all by the SAME amount -- the table must stay sorted; the
+    pending entries form a suffix of the sorted table, so a uniform shift
+    preserves order).  Returns (deferred_count, new_cfg, new_backoff_ms);
+    (0, cfg, backoff) when nothing is pending or deferral is disabled."""
+    from gossip_simulator_tpu import arrivals as _arrivals
+
+    from gossip_simulator_tpu.backends.base import WINDOW_MS
+
+    if cfg.serve_max_defer <= 0:
+        return 0, cfg, backoff_ms
+    table = np.asarray(_arrivals.arrival_ticks(cfg), np.int64)
+    pending = table > current_tick
+    count = int(pending.sum())
+    if count == 0:
+        return 0, cfg, backoff_ms
+    step = min(max(backoff_ms * 2, WINDOW_MS), cfg.serve_max_defer)
+    shifted = table.copy()
+    shifted[pending] += step
+    new_cfg = cfg.replace(inject_ticks=tuple(int(t) for t in shifted))
+    return count, new_cfg, step
+
+
+def reshard(cfg: Config, stepper: Stepper, new_shards: int, window: int,
+            stats: Stats) -> tuple[Stepper, float]:
+    """The zero-loss transition: snapshot -> (optional durable checkpoint)
+    -> fresh stepper at `new_shards` -> restore.  Returns the new stepper
+    and the pause in wall-clock ms (the serving SLO cost of the resize)."""
+    from gossip_simulator_tpu.utils import checkpoint
+
+    t0 = time.perf_counter()
+    old = shard_count(stepper)
+    with _trace.span("serve.reshard", cat="phase", window=window,
+                     from_shards=old, to_shards=new_shards) as sp:
+        tree = stepper.state_pytree()
+        if tree is not None and cfg.checkpoint_dir and stepper.primary_host:
+            checkpoint.save(cfg.checkpoint_dir, window, tree, stats,
+                            extra_meta={"reshard_to": new_shards})
+            checkpoint.prune(cfg.checkpoint_dir, cfg.ckpt_keep)
+        new_stepper = build_stepper(cfg, new_shards)
+        new_stepper.load_state_pytree(tree)
+        if sp is not None:
+            sp["pause_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
+    return new_stepper, (time.perf_counter() - t0) * 1000.0
+
+
+def run_serve(cfg: Config, stepper: Stepper, printer: ProgressPrinter,
+              max_windows: int, resume_window: int = 0,
+              collect_rows: bool = False) -> ServeOutcome:
+    """The serving loop (driver phase 2 under -serve).  `stepper` arrives
+    initialized and seeded; the returned outcome's stepper is whichever
+    incarnation served the final window."""
+    from gossip_simulator_tpu.utils import checkpoint
+
+    live_cfg = cfg
+    s = shard_count(stepper)
+    devices = len(jax.devices())
+    max_s = devices if cfg.serve_max_shards == -1 else min(
+        cfg.serve_max_shards, devices)
+    min_s = min(cfg.serve_min_shards, max_s)
+    force = parse_serve_force(cfg.serve_force)
+    target = cfg.coverage_target
+
+    rows: list = []
+    decisions: list = []
+    segments: list = []
+    windows = 0
+    converged = False
+    interrupted = False
+    shed = 0
+    backoff_ms = 0
+    hi_run = lo_run = 0
+    pause_total = 0.0
+    seg_start_tick = 0
+    seg_start_msg = 0
+    stats = stepper.stats()
+
+    def _close_segment(end_tick: int, end_msg: int) -> None:
+        span_ms = end_tick - seg_start_tick
+        if span_ms <= 0:
+            return
+        rate = (end_msg - seg_start_msg) / (span_ms / 1000.0)
+        segments.append({
+            "shards": s, "start_tick": seg_start_tick, "end_tick": end_tick,
+            "deliveries": end_msg - seg_start_msg,
+            "deliveries_per_sec_per_shard": round(rate / max(s, 1), 1),
+        })
+
+    while windows < max_windows:
+        with _trace.span("serve.window", cat="window") as sp:
+            stats = stepper.gossip_window()
+            if sp is not None:
+                sp.update(round=int(stats.round), shards=s,
+                          received=int(stats.total_received))
+        windows += 1
+        if collect_rows:
+            rows.append((stats.round, stats.total_received,
+                         stats.total_message, stats.total_crashed,
+                         stats.total_removed))
+        printer.coverage_window(round(stats.coverage * 100.0, 4),
+                                stepper.sim_time_ms())
+        if (live_cfg.checkpointing_enabled
+                and windows % live_cfg.checkpoint_every == 0):
+            tree = stepper.state_pytree()
+            if tree is not None and stepper.primary_host:
+                checkpoint.save(live_cfg.checkpoint_dir,
+                                resume_window + windows, tree, stats)
+                checkpoint.prune(live_cfg.checkpoint_dir,
+                                 live_cfg.ckpt_keep)
+        if stats.coverage >= target:
+            converged = True
+            break
+        # The windowed loop's exhaustion break, with the streaming guard:
+        # an empty ring is not a dead run while the (possibly deferred)
+        # schedule still has rumors to start.
+        if stats.exhausted and stats.round > live_cfg.last_inject_tick:
+            break
+        if _lifecycle.shutdown_requested():
+            interrupted = True
+            break
+
+        # --- autoscaler ---------------------------------------------------
+        occ = _occupancy(stepper, live_cfg, s)
+        if occ < cfg.serve_high:
+            backoff_ms = 0
+        target_s: Optional[int] = None
+        action = ""
+        if windows in force:
+            t = force[windows]
+            if t != s:
+                if not (min_s <= t <= max_s) or cfg.n % t or t > devices:
+                    raise ValueError(
+                        f"-serve-force {t}@{windows}: target must divide n "
+                        f"({cfg.n}), fit [{min_s}, {max_s}] and the "
+                        f"{devices} visible devices")
+                target_s, action = t, ("widen" if t > s else "narrow")
+        else:
+            hi_run = hi_run + 1 if occ >= cfg.serve_high else 0
+            lo_run = lo_run + 1 if occ <= cfg.serve_low else 0
+            if hi_run >= cfg.serve_window:
+                hi_run = 0
+                up = next_shard_count(s, +1, min_s, max_s, cfg.n)
+                if up != s:
+                    target_s, action = up, "widen"
+                else:
+                    # Widest mesh still saturated: defer the pending
+                    # injections (graceful degradation, never loss).
+                    deferred, new_cfg, backoff_ms = defer_pending(
+                        live_cfg, stats.round, backoff_ms)
+                    if deferred:
+                        shed += deferred
+                        live_cfg = new_cfg
+                        stepper, pause = reshard(live_cfg, stepper, s,
+                                                 resume_window + windows,
+                                                 stats)
+                        pause_total += pause
+                        entry = {"window": windows, "tick": stats.round,
+                                 "action": "defer", "from": s, "to": s,
+                                 "occupancy": round(occ, 4),
+                                 "deferred": deferred,
+                                 "backoff_ms": backoff_ms,
+                                 "pause_ms": round(pause, 3)}
+                        decisions.append(entry)
+                        _trace.instant("serve.decision", **entry)
+                        printer.note(
+                            f"serve: deferred {deferred} pending "
+                            f"injections by {backoff_ms}ms (occupancy "
+                            f"{occ:.2f} at widest mesh S={s})")
+            elif lo_run >= cfg.serve_window:
+                lo_run = 0
+                down = next_shard_count(s, -1, min_s, max_s, cfg.n)
+                if down != s:
+                    target_s, action = down, "narrow"
+        if target_s is not None:
+            _close_segment(stats.round, stats.total_message)
+            stepper, pause = reshard(live_cfg, stepper, target_s,
+                                     resume_window + windows, stats)
+            pause_total += pause
+            entry = {"window": windows, "tick": stats.round,
+                     "action": action, "from": s, "to": target_s,
+                     "occupancy": round(occ, 4),
+                     "pause_ms": round(pause, 3)}
+            decisions.append(entry)
+            _trace.instant("serve.decision", **entry)
+            printer.note(
+                f"serve: {action} S={s}->{target_s} at window {windows} "
+                f"(occupancy {occ:.2f}, pause {pause:.0f}ms)")
+            s = target_s
+            seg_start_tick = stats.round
+            seg_start_msg = stats.total_message
+            hi_run = lo_run = 0
+
+    _close_segment(stats.round, stats.total_message)
+    report = {
+        "arrivals": cfg.arrivals,
+        "final_shards": s,
+        "resizes": sum(1 for d in decisions
+                       if d["action"] in ("widen", "narrow")),
+        "reshard_pause_ms": round(pause_total, 3),
+        "shed": shed,
+        "watermarks": {"high": cfg.serve_high, "low": cfg.serve_low,
+                       "window": cfg.serve_window},
+        "decisions": decisions,
+        "segments": segments,
+    }
+    return ServeOutcome(stepper=stepper, cfg=live_cfg, windows=windows,
+                        converged=converged, interrupted=interrupted,
+                        rows=rows, report=report, shed=shed)

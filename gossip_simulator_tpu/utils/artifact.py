@@ -183,6 +183,11 @@ class RunDir:
     def write_result(self, payload: dict) -> str:
         return self._write_json("result.json", payload)
 
+    def write_serve(self, doc: dict) -> str:
+        """Serve-mode sidecar: the autoscaler decision log, per-reshard
+        pause spans and SLO summary (gossip_simulator_tpu/serve.py)."""
+        return self._write_json("serve.json", doc)
+
 
 def load_run(path: str) -> dict:
     """Read a run dir back for comparison: the JSON artifacts plus the
@@ -202,4 +207,8 @@ def load_run(path: str) -> dict:
             out["telemetry"] = {k: z[k] for k in z.files}
     else:
         out["telemetry"] = {}
+    serve = os.path.join(path, "serve.json")
+    if os.path.exists(serve):
+        with open(serve) as f:
+            out["serve"] = json.load(f)
     return out

@@ -76,6 +76,38 @@ def latest(ckpt_dir: str) -> Optional[str]:
     return os.path.join(ckpt_dir, snaps[-1]) if snaps else None
 
 
+def prune(ckpt_dir: str, keep: int, prefix: str = "state") -> list[str]:
+    """Retention: remove all but the newest `keep` ``{prefix}_*.npz``
+    snapshots, each with its ``.json`` sha256 sidecar, plus any stale
+    ``.tmp`` partials a crashed save left behind (``latest()`` already
+    ignores them, but a serve loop resharding repeatedly must not fill
+    the disk with them either).  Called AFTER a successful save, so the
+    newest snapshot is always the one just written; single-writer by
+    design (the driver checkpoints from the primary host only).  Returns
+    the removed paths.  ``keep <= 0`` means keep everything."""
+    removed: list[str] = []
+    if keep <= 0 or not os.path.isdir(ckpt_dir):
+        return removed
+    names = os.listdir(ckpt_dir)
+    snaps = sorted(p for p in names
+                   if p.startswith(prefix + "_") and p.endswith(".npz"))
+    doomed = snaps[:-keep] if keep < len(snaps) else []
+    partials = [p for p in names
+                if p.startswith(prefix + "_") and p.endswith(".tmp")]
+    for name in doomed:
+        for f in (name, name + ".json"):
+            path = os.path.join(ckpt_dir, f)
+            if os.path.exists(path):
+                os.remove(path)
+                removed.append(path)
+    for name in partials:
+        path = os.path.join(ckpt_dir, name)
+        if os.path.exists(path):
+            os.remove(path)
+            removed.append(path)
+    return removed
+
+
 def load(path: str) -> tuple[dict[str, np.ndarray], dict]:
     """Load one snapshot, verifying the sidecar's sha256 content digest
     when present (pre-digest snapshots load without the check).  A

@@ -1,0 +1,72 @@
+"""Graceful-shutdown plumbing (ISSUE 11 satellite 1).
+
+A served simulation is a long-lived process; killing it must not lose
+work.  `install_signal_handlers()` converts the FIRST SIGTERM/SIGINT into
+a cooperative flag the driver's loops poll at window boundaries -- the run
+then saves a final atomic checkpoint (when a -checkpoint-dir is set),
+flushes the run-dir artifacts with reason "interrupted", and exits through
+the normal result path (exit code 2, the standard not-converged code).  A
+SECOND signal restores the default disposition and re-raises, so a wedged
+run can still be killed hard.
+
+The flag is process-global on purpose: signals are process-global, and
+the driver's phase loops all consult the same predicate.  Host-side only
+-- nothing here touches traced programs, so trajectories are unchanged
+whether or not handlers are installed (an un-signalled run never observes
+the flag).  The fast-path device loops poll between bounded dispatches
+(backends/base.run_bounded_to_target), so even a non-checkpointing run
+reacts within one bounded call.
+"""
+
+from __future__ import annotations
+
+import signal
+
+_shutdown_signum: int | None = None
+_installed = False
+
+
+def shutdown_requested() -> bool:
+    return _shutdown_signum is not None
+
+
+def shutdown_signal() -> int | None:
+    return _shutdown_signum
+
+
+def request_shutdown(signum: int = signal.SIGTERM) -> None:
+    """Raise the flag programmatically (tests, embedding hosts)."""
+    global _shutdown_signum
+    _shutdown_signum = signum
+
+
+def reset() -> None:
+    """Clear the flag (tests; a new run in the same process)."""
+    global _shutdown_signum
+    _shutdown_signum = None
+
+
+def _handler(signum, frame):
+    global _shutdown_signum
+    if _shutdown_signum is not None:
+        # Second signal: the user means it -- die the default way.
+        signal.signal(signum, signal.SIG_DFL)
+        signal.raise_signal(signum)
+        return
+    _shutdown_signum = signum
+
+
+def install_signal_handlers() -> bool:
+    """Install the SIGTERM/SIGINT handlers (main thread only -- signal
+    delivery outside it raises ValueError, in which case shutdown stays
+    signal-less and this returns False).  Idempotent."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+    except ValueError:
+        return False
+    _installed = True
+    return True

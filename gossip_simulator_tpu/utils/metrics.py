@@ -76,6 +76,10 @@ class Stats:
     rumors: int = 1  # concurrent rumor count R (1 = classic single-rumor)
     rumor_min_recv: int = -1  # min over rumors of per-rumor infected count
     rumors_done: int = 0  # rumors that have reached the coverage target
+    # Serve-mode admission control: injections deferred (with capped
+    # backoff, never dropped) because the widest mesh was saturated.  A
+    # rumor deferred twice counts twice; always 0 outside -serve.
+    shed: int = 0
 
     @property
     def coverage(self) -> float:

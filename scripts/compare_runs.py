@@ -39,7 +39,7 @@ STAT_FIELDS = ("round", "coverage", "converged", "reason",
                "total_removed", "makeups", "breakups", "mailbox_dropped",
                "exchange_overflow", "scen_crashed", "scen_recovered",
                "part_dropped", "heal_repaired", "exhausted",
-               "rumors", "rumors_done", "fingerprint",
+               "rumors", "rumors_done", "shed", "fingerprint",
                "fingerprint_windows")
 
 
