@@ -156,6 +156,11 @@ QUEUED_HARDWARE_ROWS = (
      "capture": "_pallas_validation",
      "what": "on-device distributional checks + fused_kernel profile "
              "rows (interpret-mode CPU rows are correctness-only)"},
+    {"row": "autotune_sweep", "queued_since": "r12",
+     "capture": "capture_autotune",
+     "what": "chunk-ladder autotune sweep at 50M/100M on a v5e-8, "
+             "neutrality-gated winners persisted to TUNING_TABLE.json "
+             "per platform/scale band"},
 )
 
 
@@ -775,6 +780,32 @@ def capture_deliver_kernel_twins(detail: dict, seed: int) -> None:
             detail[f"{name}_{kern}"] = row
 
 
+def capture_autotune(detail: dict, seed: int) -> None:
+    """TPU chunk-ladder autotune sweep at the 50M and 100M bands
+    (ISSUE 12): scripts/autotune.py's coordinate sweep through THIS
+    module's warm+timed protocol, neutrality-gated against the
+    default-constants twin, winners persisted per (platform, device_kind,
+    scale band) into the committed TUNING_TABLE.json.  Each candidate
+    already rides pool_retry inside sweep_space, so a mid-sweep pool
+    fault costs candidates, not the record; a fault before the baseline
+    lands here as the usual dated skip."""
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "autotune", os.path.join(here, "scripts", "autotune.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from gossip_simulator_tpu import tuning
+
+    for name, n in (("autotune_sweep_50m", 50_000_000),
+                    ("autotune_sweep_100m", 100_000_000)):
+        def _sweep(n=n):
+            return mod.sweep_space("chunk_ladder", n, seed=seed,
+                                   table_file=tuning.COMMITTED_TABLE)
+        detail[name] = pool_retry(_sweep, name=name)
+
+
 def capture_100m(detail: dict, seed: int, headline_n: int) -> None:
     """The 100M single-chip rows (BASELINE.md north-star scale), captured in
     the driver-recorded bench output rather than only in the README.
@@ -1043,6 +1074,9 @@ def main() -> int:
             # -deliver-kernel fused-vs-XLA wall-clock twins at 50M/100M
             # (ISSUE 9; dated skips re-queue when the pool is down).
             capture_deliver_kernel_twins(result["detail"], args.seed)
+            # Chunk-ladder autotune sweep at the 50M/100M bands
+            # (ISSUE 12): winners land in TUNING_TABLE.json.
+            capture_autotune(result["detail"], args.seed)
             # Refresh the salvage so a worker fault in the near-ceiling
             # 100M rows can't discard the just-measured sharded twins.
             with open(partial, "w") as fh:

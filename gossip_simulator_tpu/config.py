@@ -333,6 +333,14 @@ class Config:
     # len == rumors).  Set by serve's admission control when it defers
     # pending injections; not a CLI flag.
     inject_ticks: Optional[tuple] = None
+    # --- tuning table (ISSUE 12; gossip_simulator_tpu/tuning.py) -------------
+    # Per-platform tuned-constant table produced by scripts/autotune.py:
+    # "auto" consults the committed TUNING_TABLE.json when present, "off"
+    # forces registered defaults, a path loads that table.  Resolution
+    # order per tunable: explicit CLI flag (-compact-chunk, -event-chunk,
+    # -event-slot-cap) > table entry > registered default; the active
+    # entry id (or "defaults") is stamped into resolved_gates().
+    tuning_table: str = "auto"
 
     # --- derived --------------------------------------------------------------
     @property
@@ -488,7 +496,11 @@ class Config:
         if (self.backend in ("jax", "sharded")
                 and self.effective_time_mode != "ticks"):
             return "rounds"
-        return "ticks" if self.n <= OVERLAY_TICKS_AUTO_MAX else "rounds"
+        from gossip_simulator_tpu import tuning as _tuning
+
+        band = _tuning.value("config.overlay_ticks_auto_max", self,
+                             default=OVERLAY_TICKS_AUTO_MAX)
+        return "ticks" if self.n <= band else "rounds"
 
     @property
     def overlay_adaptive_chunks_resolved(self) -> bool:
@@ -530,6 +542,17 @@ class Config:
         from gossip_simulator_tpu.ops import pallas_deliver
         return pallas_deliver.tpu_unsupported()
 
+    @property
+    def tuning_entry_resolved(self) -> str:
+        """Active tuning-table entry id, or "defaults" -- resolved LAZILY
+        (table matching keys on the jax platform fingerprint, so the
+        lookup happens post-setup like deliver_kernel_resolved; validate()
+        must not import jax).  Never raises: any table-resolution error
+        degrades to "defaults", the values the run would use anyway."""
+        from gossip_simulator_tpu import tuning
+
+        return tuning.entry_id(self)
+
     def resolved_gates(self) -> dict:
         """The resolved gate set, stamped into run artifacts and the
         terminal `result` record so a trajectory is attributable without
@@ -562,6 +585,12 @@ class Config:
                 gates["deliver_kernel"] = "unavailable"
         else:
             gates["deliver_kernel"] = None
+        # The active tuning-table entry id ("defaults" when no table
+        # matches): a table CAN carry trajectory-affecting values (it is
+        # reviewed, committed data -- autotune itself only persists
+        # neutral-by-contract tunables), so compare_runs names a mismatch
+        # here as the first divergence suspect.
+        gates["tuning_table"] = self.tuning_entry_resolved
         return gates
 
     @property
@@ -848,6 +877,13 @@ class Config:
             parse_serve_force(self.serve_force)  # raises on a bad spec
         if self.ckpt_keep < 0:
             raise ValueError(f"ckpt_keep must be >= 0, got {self.ckpt_keep}")
+        if self.tuning_table not in ("auto", "off"):
+            import os
+
+            if not os.path.exists(self.tuning_table):
+                raise ValueError(
+                    f"-tuning-table: no such file {self.tuning_table!r} "
+                    "(use 'auto', 'off', or a tuning-table JSON path)")
         # --- fault-injection scenario ------------------------------------
         scen = self.scenario_resolved  # raises ValueError on a bad spec
         if scen.active:
@@ -1144,6 +1180,13 @@ def _build_parser() -> argparse.ArgumentParser:
                    default=d.ckpt_keep,
                    help="keep only the newest K checkpoint snapshots after "
                         "each successful save (0 = keep all)")
+    p.add_argument("-tuning-table", "--tuning-table", dest="tuning_table",
+                   default=d.tuning_table,
+                   help="tuned-constant table (scripts/autotune.py): auto "
+                        "= the committed TUNING_TABLE.json when present, "
+                        "off = registered defaults, or a table path; "
+                        "explicit flags like -event-chunk still outrank "
+                        "table entries")
     p.add_argument("-profile", "--profile", action="store_true")
     p.add_argument("-profile-dir", "--profile-dir", dest="profile_dir",
                    default=d.profile_dir)

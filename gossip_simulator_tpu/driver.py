@@ -14,6 +14,7 @@ import os
 import time
 from typing import Optional
 
+from gossip_simulator_tpu import tuning as _tuning
 from gossip_simulator_tpu.backends import make_stepper
 from gossip_simulator_tpu.backends.base import Stepper, WINDOW_MS
 from gossip_simulator_tpu.config import Config
@@ -54,7 +55,10 @@ def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
         tracer = _trace.Tracer(path=cfg.trace_resolved,
                                xprof_dir=cfg.xprof_dir)
     try:
-        with _trace.activated(tracer):
+        # Ambient tuning config: cfg-less tunable lookups deeper in the
+        # stack (exchange pad/rank path, pallas block rows) resolve this
+        # run's tuning table instead of falling back to registry defaults.
+        with _trace.activated(tracer), _tuning.ambient(cfg):
             return _run(cfg, printer, stepper)
     finally:
         # Close on ANY exit so a raised run still flushes the JSONL log
@@ -83,6 +87,17 @@ def _run(cfg: Config, printer: ProgressPrinter,
             f"overlay-heal {cfg.overlay_heal}"
             + (f" (detect {cfg.heal_detect_ms}ms)"
                if cfg.overlay_heal_resolved else ""))
+    entry = _tuning.entry_for(cfg)
+    if entry is not None and any(
+            v != _tuning.REGISTRY[k].default
+            for k, v in entry.get("values", {}).items()
+            if k in _tuning.REGISTRY):
+        # Same self-describing-transcript rationale as the scenario banner:
+        # a run whose constants were MOVED by a table entry says which one.
+        # An all-defaults entry stays silent -- it produces the identical
+        # program, and the golden transcripts pin that.
+        printer.note(f"tuning: table entry {entry['id']} active "
+                     f"(table {cfg.tuning_table})")
     t_init = time.perf_counter()
     with _trace.span("init", cat="phase"):
         stepper.init()

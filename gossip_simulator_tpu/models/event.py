@@ -73,6 +73,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gossip_simulator_tpu import scenario as _scen
+from gossip_simulator_tpu import tuning as _tuning
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic
 # in_flight: canonical engine-agnostic definition in models/state.py,
@@ -186,8 +187,11 @@ def slot_cap(cfg: Config, n_local: int | None = None) -> int:
     # aggregate budget is the MEAN out-degree (for erdos ~3x smaller than
     # the padded column width), plus one for SIR's re-broadcast trigger.
     deg = cfg.mean_degree + (1 if cfg.protocol == "sir" else 0)
+    # 1.5x skew headroom is a registered tunable (tuning.py); an explicit
+    # -event-slot-cap outranks it entirely.
+    headroom = _tuning.value("event.slot_headroom", cfg)
     cap = cfg.event_slot_cap if cfg.event_slot_cap > 0 else max(
-        4096, int(math.ceil(1.5 * n * deg * b
+        4096, int(math.ceil(headroom * n * deg * b
                             / max(cfg.delay_span, 1))))
     # One slot can never hold more than every SI message plus padding
     # (SIR re-broadcasts indefinitely, so the bound only applies to SI).
@@ -280,16 +284,22 @@ def _chunk_want(cfg: Config, n_local: int | None = None) -> int:
     if cfg.event_chunk > 0:
         want = cfg.event_chunk
     else:
+        # The ramp's floor and per-branch ceilings are registered
+        # tunables (tuning.py "chunk_ladder" space -- the ladder the
+        # deleted scripts/chunk_sweep*.py swept by hand); an explicit
+        # -event-chunk outranks any table entry via the branch above.
         r = max(1.0, cfg.mean_degree / 4.0)
-        hi = 1_048_576 if r >= 1.5 else 524_288
+        hi = (_tuning.value("event.drain_chunk_hi", cfg) if r >= 1.5
+              else _tuning.value("event.drain_chunk_hi_lowdeg", cfg))
         if cfg.dup_suppress_resolved and r >= 1.5:
             # Suppression shrinks the drained entry volume ~1.4x and the
             # ring itself (slot_cap band), moving the optimum up again:
             # 1e8 fanout 6 @99% swept 2026-07-31 (cap 1.34e8): 1M:27.6,
             # 2M:24.9, 4M:24.3, 8M:26.6 s -- per-batch op floors beat
             # element growth until ~4M.
-            hi = 4_194_304
-        want = min(hi, max(131_072, int(n // 128 * r ** 3)))
+            hi = _tuning.value("event.drain_chunk_hi_suppress", cfg)
+        floor = _tuning.value("event.drain_chunk_floor", cfg)
+        want = min(hi, max(floor, int(n // 128 * r ** 3)))
         # Round up to a power of two: the sort pads to one internally, so
         # a 918k chunk costs a 1M sort but drains only 918k entries
         # (measured 55.6s vs 49.5s at the 1e8 fanout-6 config).

@@ -39,6 +39,7 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from gossip_simulator_tpu import tuning as _tuning
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models.state import OverlayState
 from gossip_simulator_tpu.ops.mailbox import deliver
@@ -108,7 +109,8 @@ def spill_cap_for(cfg: Config, n_rows: int) -> int:
     if not spill_enabled(cap):
         return 0
     if static_boot_applies(cfg, None):
-        return SPILL_CAP + int(1.6 * n_rows
+        margin = _tuning.value("overlay.spill_margin", cfg)
+        return SPILL_CAP + int(margin * n_rows
                                * _poisson_excess(float(cfg.fanout), cap))
     return SPILL_CAP
 
@@ -211,10 +213,14 @@ def delivery_chunk(cfg: Config, n_rows: int) -> int:
     the ROUNDS engine (and its sharded variant); the tick-faithful
     engine's slot drain has its own scaling
     (overlay_ticks.ticks_delivery_chunk -- its per-chunk cost is
-    scatter-floor-bound at GB-scale targets, favoring fat chunks)."""
+    scatter-floor-bound at GB-scale targets, favoring fat chunks).
+    The 65_536 base and 1M cap are registered tunables (tuning.py):
+    an explicit -compact-chunk outranks any table entry."""
     if cfg.compact_chunk > 0:
         return cfg.compact_chunk
-    return min(n_rows, max(65_536, n_rows // 128), 1_048_576)
+    base = _tuning.value("overlay.delivery_chunk_base", cfg)
+    cap = _tuning.value("overlay.delivery_chunk_cap", cfg)
+    return min(n_rows, max(base, n_rows // 128), cap)
 
 
 # Fattest rung of the adaptive hosted-chunk ladder (hosted_chunk_widths):
@@ -241,7 +247,11 @@ def hosted_chunk_widths(cfg: Config, n_rows: int) -> tuple[int, ...]:
     base = delivery_chunk(cfg, n_rows)
     if not cfg.overlay_adaptive_chunks_resolved:
         return (base,)
-    hi = max(base, min(n_rows, ADAPTIVE_CHUNK_MAX))
+    # The module global stays the monkeypatchable default (tests lower
+    # it); a tuning-table entry overrides it per platform/band.
+    rung_max = _tuning.value("overlay.adaptive_chunk_max", cfg,
+                             default=ADAPTIVE_CHUNK_MAX)
+    hi = max(base, min(n_rows, rung_max))
     widths = [base]
     while widths[-1] < hi:
         widths.append(min(widths[-1] * 4, hi))

@@ -175,10 +175,14 @@ def ticks_delivery_chunk(cfg: Config, n_rows: int) -> int:
     n/8 rounded up to a power of two (the sort pads internally), floor
     64k (<= 512k rows keeps the swept small-n optimum), cap 2M.
     Chunking is trajectory-neutral (rank continuation), so this is pure
-    perf; -compact-chunk overrides."""
+    perf; -compact-chunk overrides.  The 2M cap is a registered tunable
+    (tuning.py: overlay_ticks.delivery_chunk_cap)."""
     if cfg.compact_chunk > 0:
         return cfg.compact_chunk
-    want = min(max(65_536, n_rows // 8), 2_097_152)
+    from gossip_simulator_tpu import tuning as _tuning
+
+    cap = _tuning.value("overlay_ticks.delivery_chunk_cap", cfg)
+    want = min(max(65_536, n_rows // 8), cap)
     return 1 << (want - 1).bit_length()
 
 

@@ -38,14 +38,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gossip_simulator_tpu import tuning as _tuning
 from gossip_simulator_tpu.ops.pallas_deliver import _interpret_param
 
 BLOCK_ROWS = 512
 LANES = 128  # minimum last-dim tile; k columns are sliced out afterwards
 
 
-def _kout_kernel(n: int, k: int, row0: int, interpret: bool, seed_ref,
-                 out_ref):
+def _kout_kernel(n: int, k: int, row0: int, br: int, interpret: bool,
+                 seed_ref, out_ref):
     blk = pl.program_id(0)
     # The output is TRANSPOSED (k, rows): a (rows, k) pallas output gets the
     # forced T(8,128) tiled layout, padding k<=6 lanes out to 128 -- 51 GB
@@ -55,32 +56,34 @@ def _kout_kernel(n: int, k: int, row0: int, interpret: bool, seed_ref,
     if interpret:
         # The interpreter has no TPU PRNG (NotImplementedError on 0.4.37):
         # keep the documented all-zero-stub semantics explicitly.
-        bits = jnp.zeros((k, BLOCK_ROWS), jnp.int32)
+        bits = jnp.zeros((k, br), jnp.int32)
     else:
         # Seed by GLOBAL block index so a row0>0 slice reproduces exactly
         # the same rows as the corresponding blocks of a full generation.
-        pltpu.prng_seed(seed_ref[0], row0 // BLOCK_ROWS + blk)
-        bits = pltpu.prng_random_bits((k, BLOCK_ROWS))
+        # NOTE the seed stream depends on br: pallas_graph.block_rows is a
+        # sweepable-but-NEVER-persisted tunable (neutral=False in tuning.py).
+        pltpu.prng_seed(seed_ref[0], row0 // br + blk)
+        bits = pltpu.prng_random_bits((k, br))
     peers = (bits.astype(jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
-    gid = (row0 + blk * BLOCK_ROWS
-           + jax.lax.broadcasted_iota(jnp.int32, (k, BLOCK_ROWS), 1))
+    gid = (row0 + blk * br
+           + jax.lax.broadcasted_iota(jnp.int32, (k, br), 1))
     out_ref[:] = jnp.where(peers == gid, (peers + 1) % n, peers)
 
 
 _ER_STREAM = 0x4552D14D  # XOR'd into the seed: decorrelates ER from kout
 
 
-def _erdos_kernel(n: int, lam: float, cap: int, row0: int, interpret: bool,
-                  seed_ref, out_ref):
+def _erdos_kernel(n: int, lam: float, cap: int, row0: int, br: int,
+                  interpret: bool, seed_ref, out_ref):
     blk = pl.program_id(0)
     if interpret:
         # Same zero-bit stub as _kout_kernel: degree 0 everywhere.
-        bits = jnp.zeros((cap + 1, BLOCK_ROWS), jnp.int32)
+        bits = jnp.zeros((cap + 1, br), jnp.int32)
     else:
         # The platform caps prng_seed at 2 values, so the stream tag folds
         # into the seed word instead of riding as a third argument.
-        pltpu.prng_seed(seed_ref[0] ^ _ER_STREAM, row0 // BLOCK_ROWS + blk)
-        bits = pltpu.prng_random_bits((cap + 1, BLOCK_ROWS))
+        pltpu.prng_seed(seed_ref[0] ^ _ER_STREAM, row0 // br + blk)
+        bits = pltpu.prng_random_bits((cap + 1, br))
     # Row 0 -> the Poisson uniform; rows 1.. -> peer picks.  The top 24 bits
     # shift into int32 range first (Mosaic has no uint32->f32 cast).
     u = (bits[0:1].astype(jnp.uint32) >> jnp.uint32(8)).astype(
@@ -101,41 +104,31 @@ def _erdos_kernel(n: int, lam: float, cap: int, row0: int, interpret: bool,
     _, _, deg = jax.lax.fori_loop(
         0, cap, body,
         (jnp.float32(_math.exp(-lam)), jnp.float32(0.0),
-         jnp.zeros((1, BLOCK_ROWS), jnp.int32)))
+         jnp.zeros((1, br), jnp.int32)))
     peers = (bits[1:].astype(jnp.uint32) % jnp.uint32(n)).astype(jnp.int32)
-    gid = (row0 + blk * BLOCK_ROWS
-           + jax.lax.broadcasted_iota(jnp.int32, (cap, BLOCK_ROWS), 1))
+    gid = (row0 + blk * br
+           + jax.lax.broadcasted_iota(jnp.int32, (cap, br), 1))
     peers = jnp.where(peers == gid, (peers + 1) % n, peers)
     out_ref[:] = jnp.concatenate([deg, peers], axis=0)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 5))
-def erdos_pallas(n: int, lam: float, row0: int, rows: int, seed,
-                 interpret: bool = False):
-    """Sparse directed Erdos-Renyi slice via the TPU PRNG: out-degree ~
-    Poisson(lam = n*p) like models/graphs.erdos (different, equally random
-    stream -- same contract as kout_pallas), peers uniform with the (id+1)%n
-    self-patch.  Returns (friends int32[rows, cap] -1-padded, deg
-    int32[rows]).  Requires lam <= 60 (f32 pmf recurrence) and
-    BLOCK_ROWS-aligned row0."""
-    if not 0.0 < lam <= 60.0:
-        raise ValueError(f"erdos_pallas requires 0 < lam <= 60, got {lam}")
-    if row0 % BLOCK_ROWS:
-        raise ValueError(f"row0 must be {BLOCK_ROWS}-aligned, got {row0}")
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 6))
+def _erdos_pallas_jit(n: int, lam: float, row0: int, rows: int, br: int,
+                      seed, interpret: bool = False):
     from gossip_simulator_tpu.config import er_cap
 
     cap = er_cap(lam)
     if cap > LANES:
         raise ValueError(f"erdos_pallas cap {cap} exceeds {LANES}")
-    nblocks = -(-rows // BLOCK_ROWS)
+    nblocks = -(-rows // br)
     seed_arr = jnp.asarray(seed, dtype=jnp.int32).reshape((1,))
     out = pl.pallas_call(
-        functools.partial(_erdos_kernel, n, lam, cap, row0, interpret),
+        functools.partial(_erdos_kernel, n, lam, cap, row0, br, interpret),
         grid=(nblocks,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec((cap + 1, BLOCK_ROWS), lambda i: (0, i),
+        out_specs=pl.BlockSpec((cap + 1, br), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((cap + 1, nblocks * BLOCK_ROWS),
+        out_shape=jax.ShapeDtypeStruct((cap + 1, nblocks * br),
                                        jnp.int32),
         interpret=_interpret_param(interpret),
     )(seed_arr)
@@ -145,28 +138,60 @@ def erdos_pallas(n: int, lam: float, row0: int, rows: int, seed,
     return friends.T, deg
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 5))
-def kout_pallas(n: int, k: int, row0: int, rows: int, seed,
-                interpret: bool = False):
-    """friends int32[rows, k]: each of rows nodes picks k uniform peers != self.
+def erdos_pallas(n: int, lam: float, row0: int, rows: int, seed,
+                 interpret: bool = False):
+    """Sparse directed Erdos-Renyi slice via the TPU PRNG: out-degree ~
+    Poisson(lam = n*p) like models/graphs.erdos (different, equally random
+    stream -- same contract as kout_pallas), peers uniform with the (id+1)%n
+    self-patch.  Returns (friends int32[rows, cap] -1-padded, deg
+    int32[rows]).  Requires lam <= 60 (f32 pmf recurrence) and
+    block-rows-aligned row0.
 
-    Requires k <= 128 and row0 % BLOCK_ROWS == 0 (shard alignment); `rows` is
-    padded up to a block multiple internally.
+    Block rows resolve through the tuning registry (pallas_graph.block_rows,
+    default BLOCK_ROWS) OUTSIDE the jit so a sweep override actually
+    retraces; the tunable changes the PRNG block stream, so it is
+    neutral=False and never table-persisted.
     """
-    if k > LANES:
-        raise ValueError(f"kout_pallas supports k <= {LANES}, got {k}")
-    if row0 % BLOCK_ROWS:
-        raise ValueError(f"row0 must be {BLOCK_ROWS}-aligned, got {row0}")
-    nblocks = -(-rows // BLOCK_ROWS)
+    if not 0.0 < lam <= 60.0:
+        raise ValueError(f"erdos_pallas requires 0 < lam <= 60, got {lam}")
+    br = int(_tuning.value("pallas_graph.block_rows", None,
+                           default=BLOCK_ROWS))
+    if row0 % br:
+        raise ValueError(f"row0 must be {br}-aligned, got {row0}")
+    return _erdos_pallas_jit(n, lam, row0, rows, br, seed, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 6))
+def _kout_pallas_jit(n: int, k: int, row0: int, rows: int, br: int, seed,
+                     interpret: bool = False):
+    nblocks = -(-rows // br)
     seed_arr = jnp.asarray(seed, dtype=jnp.int32).reshape((1,))
     out = pl.pallas_call(
-        functools.partial(_kout_kernel, n, k, row0, interpret),
+        functools.partial(_kout_kernel, n, k, row0, br, interpret),
         grid=(nblocks,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec((k, BLOCK_ROWS), lambda i: (0, i),
+        out_specs=pl.BlockSpec((k, br), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((k, nblocks * BLOCK_ROWS),
+        out_shape=jax.ShapeDtypeStruct((k, nblocks * br),
                                        jnp.int32),
         interpret=_interpret_param(interpret),
     )(seed_arr)
     return out[:, :rows].T
+
+
+def kout_pallas(n: int, k: int, row0: int, rows: int, seed,
+                interpret: bool = False):
+    """friends int32[rows, k]: each of rows nodes picks k uniform peers != self.
+
+    Requires k <= 128 and row0 aligned to the resolved block rows (shard
+    alignment); `rows` is padded up to a block multiple internally.  Block
+    rows resolve via tuning (pallas_graph.block_rows, default BLOCK_ROWS)
+    outside the jit -- see erdos_pallas.
+    """
+    if k > LANES:
+        raise ValueError(f"kout_pallas supports k <= {LANES}, got {k}")
+    br = int(_tuning.value("pallas_graph.block_rows", None,
+                           default=BLOCK_ROWS))
+    if row0 % br:
+        raise ValueError(f"row0 must be {br}-aligned, got {row0}")
+    return _kout_pallas_jit(n, k, row0, rows, br, seed, interpret)

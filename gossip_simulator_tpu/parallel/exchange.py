@@ -35,6 +35,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from gossip_simulator_tpu import tuning as _tuning
 from gossip_simulator_tpu.ops.mailbox import segment_ranks
 from gossip_simulator_tpu.parallel.mesh import AXIS
 
@@ -71,7 +72,8 @@ def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
         overflow: int32[] messages dropped for capacity locally.
     """
     if sort_buckets is None:
-        sort_buckets = n_shards > RANK_MAX_SHARDS
+        sort_buckets = n_shards > _tuning.value(
+            "exchange.rank_max_shards", None, default=RANK_MAX_SHARDS)
     key = jnp.where(valid, dest_shard, n_shards).astype(I32)
     if sort_buckets:
         # Stable sort + segment ranks (the round-1 path, kept for wide
@@ -151,7 +153,8 @@ def chernoff_cap(m_edges: int, n_shards: int) -> int:
     if n_shards <= 1:
         return m_edges
     mean = -(-m_edges // n_shards)
-    return int(min(m_edges, mean + max(64, math.ceil(8 * math.sqrt(mean)))))
+    pad = _tuning.value("exchange.chernoff_pad", None)
+    return int(min(m_edges, mean + max(64, math.ceil(pad * math.sqrt(mean)))))
 
 
 def pack_dst_slot(dst_local: jnp.ndarray, dslot: jnp.ndarray, d: int):

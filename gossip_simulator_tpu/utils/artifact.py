@@ -142,6 +142,10 @@ class RunDir:
         return out
 
     def write_config(self, cfg) -> str:
+        # resolved_gates carries the active tuning-table entry id (or
+        # "defaults"), so every archived run names the tuned-constant set
+        # it ran under -- compare_runs.py reads it as the first
+        # divergence suspect.
         doc = {"flags": dataclasses.asdict(cfg),
                "resolved": cfg.resolved_gates()}
         return self._write_json("config.json", doc)

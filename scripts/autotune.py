@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Artifact-driven autotuner: sweep the registered tunable surface and
+persist neutrality-gated winners to a per-platform tuning table.
+
+    python scripts/autotune.py --space chunk_ladder --n 262144
+    python scripts/autotune.py --space chunk_ladder --n 10000 \
+        --tunable event.drain_chunk_floor --candidates 4096,8192 \
+        --plant event.slot_headroom=0.01 --table /tmp/tt.json
+
+Each candidate value is timed through bench.py's warm+timed protocol
+(`_bench_backend`) with a run-dir artifact per row, and its trajectory
+fingerprint is compared against the default-constants twin measured the
+same way in the same process.  ANY fingerprint mismatch rejects the
+candidate -- the perf search can never change simulation results.  A
+surviving candidate displaces the default only when it wins by
+--win-margin (CPU wall clocks are noisy; a tie keeps the shipped
+constant).
+
+Winners merge into a tuning-table JSON entry keyed by (platform,
+device_kind, scale band, space) -- see gossip_simulator_tpu/tuning.py
+for the schema and the resolution order Config applies.  Only tunables
+registered neutral=True are persisted (capacity-like constants pass the
+gate at ONE shape without that transferring to the rest of the band;
+their sweeps are timing evidence only).  The entry is written even when
+every winner is the default, so a table round-trip is always testable.
+
+Exit codes: 0 sweep completed (rejections are normal -- that is the gate
+working), 2 usage / environment error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+from gossip_simulator_tpu import tuning  # noqa: E402
+from gossip_simulator_tpu.config import Config  # noqa: E402
+
+
+def _row_name(name: str, value) -> str:
+    return f"{name}={value}".replace("/", "_")
+
+
+def _run_candidate(cfg: Config, row: str, overrides: dict,
+                   workdir: str) -> dict:
+    """One measured row: bench warm+timed protocol under the candidate's
+    override context, artifact written to workdir/<row>/.  Returns the
+    bench row dict plus the run-dir fingerprint (pool failures come back
+    as bench skip records -- recorded, not fatal, so a flaky TPU pool
+    costs one candidate, not the sweep)."""
+    with tuning.override(overrides):
+        rec = bench.pool_retry(bench._bench_backend, cfg, name=row)
+    if rec.get("skipped"):
+        return rec
+    with open(os.path.join(workdir, row, "result.json")) as fh:
+        rec["fingerprint"] = json.load(fh)["fingerprint"]
+    return rec
+
+
+def _merge_entry(table_file: str, entry: dict) -> None:
+    """Replace-or-append the entry keyed by (platform, device_kind,
+    scale_band, space); atomic write, entries sorted by id for stable
+    diffs of the committed table."""
+    doc = {"schema": tuning.TABLE_SCHEMA, "entries": []}
+    if os.path.exists(table_file):
+        with open(table_file) as fh:
+            doc = json.load(fh)
+        if doc.get("schema") != tuning.TABLE_SCHEMA:
+            raise SystemExit(f"{table_file}: schema {doc.get('schema')!r} "
+                             f"!= {tuning.TABLE_SCHEMA}")
+    key = ("platform", "device_kind", "scale_band", "space")
+    doc["entries"] = [e for e in doc.get("entries", ())
+                      if tuple(e.get(k) for k in key)
+                      != tuple(entry[k] for k in key)]
+    doc["entries"].append(entry)
+    doc["entries"].sort(key=lambda e: e["id"])
+    tmp = table_file + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, table_file)
+
+
+def sweep_space(space_name: str, n: int, seed: int = 3,
+                table_file: str | None = None, workdir: str | None = None,
+                tunable: str | None = None, candidates: list | None = None,
+                plant: tuple | None = None, win_margin: float = 0.03,
+                log=print) -> dict:
+    """Run one space's coordinate-wise sweep at (n, seed) on the current
+    platform; persist the entry to `table_file` (None skips persistence).
+    Callable from tests and bench captures; returns the summary dict."""
+    space = tuning.SPACES[space_name]
+    platform, kind = tuning._platform()
+    if space.tpu_only and platform != "tpu":
+        raise SystemExit(f"space {space_name!r} is TPU-only "
+                         f"(current platform: {platform})")
+    band = tuning.scale_band(n)
+    workdir = workdir or tempfile.mkdtemp(prefix="autotune_")
+    os.makedirs(workdir, exist_ok=True)
+
+    # Candidate runs resolve overrides only: tuning_table="off" keeps any
+    # committed table out of both the baseline twin and the candidates.
+    cfg = Config(n=n, seed=seed, progress=False, tuning_table="off",
+                 **space.workload).validate()
+
+    names = (tunable,) if tunable else space.tunables
+    for name in names:
+        if name not in space.tunables:
+            raise SystemExit(f"tunable {name!r} not in space {space_name!r} "
+                             f"({space.tunables})")
+
+    prev_root = bench._RUN_DIR_ROOT
+    bench._RUN_DIR_ROOT = workdir
+    try:
+        log(f"[autotune] space={space_name} n={n} band={band} "
+            f"platform={platform}/{kind or 'any'} workdir={workdir}")
+        base = _run_candidate(cfg, "baseline", {}, workdir)
+        if base.get("skipped"):
+            raise SystemExit(f"baseline run failed: {base.get('error')}")
+        base_fp, base_s = base["fingerprint"], base["run_s"]
+        log(f"[autotune] baseline (defaults): {base_s:.3f}s "
+            f"fingerprint {base_fp}")
+
+        rows, winners = [], {}
+        todo = []
+        for name in names:
+            t = tuning.REGISTRY[name]
+            cands = ([t.kind(c) for c in candidates] if candidates
+                     else t.candidates)
+            todo += [(name, v) for v in cands if v != t.default]
+        if plant:
+            todo.append(plant)
+
+        for name, v in todo:
+            row = _row_name(name, v)
+            rec = _run_candidate(cfg, row, {name: v}, workdir)
+            if rec.get("skipped"):
+                rows.append({"tunable": name, "value": v,
+                             "verdict": "error", "error": rec.get("error")})
+                log(f"[autotune]   {row}: ERROR {rec.get('error')}")
+                continue
+            fp, run_s = rec["fingerprint"], rec["run_s"]
+            if fp != base_fp:
+                # THE neutrality gate: a candidate that moved the
+                # trajectory is out, however fast it ran.
+                rows.append({"tunable": name, "value": v, "run_s": run_s,
+                             "fingerprint": fp, "verdict": "rejected"})
+                log(f"[autotune]   {row}: {run_s:.3f}s fingerprint {fp} "
+                    f"REJECTED (non-neutral: trajectory diverged from the "
+                    f"default-constants twin {base_fp})")
+                continue
+            rows.append({"tunable": name, "value": v, "run_s": run_s,
+                         "fingerprint": fp, "verdict": "neutral"})
+            log(f"[autotune]   {row}: {run_s:.3f}s fingerprint match")
+            best = winners.get(name)
+            if ((best is None or run_s < best[1])
+                    and run_s < base_s * (1.0 - win_margin)):
+                winners[name] = (v, run_s)
+    finally:
+        bench._RUN_DIR_ROOT = prev_root
+
+    persisted = {}
+    for name in names:
+        t = tuning.REGISTRY[name]
+        won = winners.get(name)
+        value = won[0] if won else t.default
+        log(f"[autotune] winner {name} = {value}"
+            + (f" ({won[1]:.3f}s vs default {base_s:.3f}s)" if won
+               else " (default retained)"))
+        if t.neutral:
+            persisted[name] = value
+        elif won:
+            log(f"[autotune]   {name} is neutral=False: timing evidence "
+                f"only, not persisted")
+
+    entry_id = f"{platform}/{kind or 'any'}/{band}/{space_name}"
+    summary = {
+        "space": space_name, "n": n, "seed": seed, "band": band,
+        "platform": platform, "device_kind": kind,
+        "baseline": {"run_s": round(base_s, 4), "fingerprint": base_fp},
+        "rows": rows,
+        "rejected": [r for r in rows if r["verdict"] == "rejected"],
+        "winners": {k: v[0] for k, v in winners.items()},
+        "persisted": persisted, "entry_id": entry_id, "table": table_file,
+    }
+    if table_file and persisted:
+        entry = {
+            "id": entry_id, "platform": platform, "device_kind": kind,
+            "scale_band": band, "space": space_name, "values": persisted,
+            "evidence": {
+                "n": n, "seed": seed,
+                "baseline_run_s": round(base_s, 4),
+                "win_margin": win_margin,
+                "rows": [{k: (round(r[k], 4) if k == "run_s" else r[k])
+                          for k in ("tunable", "value", "run_s", "verdict")
+                          if k in r} for r in rows],
+            },
+        }
+        _merge_entry(table_file, entry)
+        log(f"[autotune] persisted entry {entry_id} -> {table_file}")
+    return summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--space", required=True, choices=sorted(tuning.SPACES),
+                   help="sweep space (tuning.SPACES)")
+    p.add_argument("--n", type=int, required=True, help="workload scale")
+    p.add_argument("--seed", type=int, default=3)
+    p.add_argument("--table", default=tuning.COMMITTED_TABLE,
+                   help="tuning-table JSON to merge the entry into "
+                        "(default: the committed TUNING_TABLE.json); "
+                        "'none' skips persistence")
+    p.add_argument("--workdir", default=None,
+                   help="run-dir root for per-candidate artifacts "
+                        "(default: a fresh temp dir)")
+    p.add_argument("--tunable", default=None,
+                   help="restrict the sweep to one tunable of the space")
+    p.add_argument("--candidates", default=None,
+                   help="comma-separated candidate values (with --tunable)")
+    p.add_argument("--plant", default=None, metavar="NAME=VALUE",
+                   help="append one extra candidate expected to be "
+                        "non-neutral -- exercises the rejection gate "
+                        "(tests/CI)")
+    p.add_argument("--win-margin", type=float, default=0.03,
+                   help="fraction a candidate must beat the default by to "
+                        "displace it (default 0.03)")
+    args = p.parse_args(argv)
+
+    cands = None
+    if args.candidates:
+        if not args.tunable:
+            p.error("--candidates requires --tunable")
+        cands = [c.strip() for c in args.candidates.split(",")]
+    plant = None
+    if args.plant:
+        name, _, raw = args.plant.partition("=")
+        if not raw or name not in tuning.REGISTRY:
+            p.error(f"--plant wants NAME=VALUE with a registered NAME, "
+                    f"got {args.plant!r}")
+        plant = (name, tuning.REGISTRY[name].kind(raw))
+
+    table = None if args.table == "none" else args.table
+    summary = sweep_space(args.space, args.n, seed=args.seed,
+                          table_file=table, workdir=args.workdir,
+                          tunable=args.tunable, candidates=cands,
+                          plant=plant, win_margin=args.win_margin)
+    log_rej = len(summary["rejected"])
+    print(f"[autotune] done: {len(summary['rows'])} candidates, "
+          f"{log_rej} rejected by the neutrality gate, persisted "
+          f"{sorted(summary['persisted'])} as {summary['entry_id']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

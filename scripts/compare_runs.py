@@ -7,7 +7,10 @@
 Answers the regression question in CI-consumable form:
 
   * trajectory fingerprint equality (the headline bit-identity check),
-  * on mismatch, the FIRST divergent telemetry window -- named row index
+  * on mismatch, a tuning-table entry mismatch is named FIRST (persisted
+    tunables are neutrality-gated, so diverging runs that resolved
+    different table entries point at a bad entry before the code),
+    then the FIRST divergent telemetry window -- named row index
     plus the differing columns by name with both values,
   * final-Stats deltas from result.json (any delta = divergence),
   * resolved-gate set differences (a gate flip explains a trajectory
@@ -72,6 +75,8 @@ def compare(a: dict, b: dict, timing_tolerance: float,
     """Print the diff; return the exit code."""
     ra, rb = a["result"], b["result"]
     diverged = False
+    ga = a["config"].get("resolved", {})
+    gb = b["config"].get("resolved", {})
 
     fa = ra.get("fingerprint")
     fb = rb.get("fingerprint")
@@ -81,6 +86,17 @@ def compare(a: dict, b: dict, timing_tolerance: float,
     else:
         diverged = True
         print(f"fingerprint: DIVERGED {fa} vs {fb}")
+        # A tuning-table mismatch is the FIRST suspect: two runs resolving
+        # different tuned-constant entries are EXPECTED to stay
+        # trajectory-identical (every persisted tunable passed the
+        # neutrality gate), so a divergence here points at a table entry
+        # that slipped a non-neutral value -- name it before the window
+        # detail.
+        tta, ttb = ga.get("tuning_table"), gb.get("tuning_table")
+        if tta != ttb:
+            print(f"  tuning-table mismatch: {tta} vs {ttb} -- a "
+                  "non-neutral table entry is the first suspect "
+                  "(scripts/autotune.py gate should have rejected it)")
         for line in _first_divergent_window(
                 a["telemetry"].get("trajectory"),
                 b["telemetry"].get("trajectory")):
@@ -98,8 +114,6 @@ def compare(a: dict, b: dict, timing_tolerance: float,
         # the two bases agree row-for-row.
         print(f"fingerprint basis: {ba} vs {bb} (informational)")
 
-    ga = a["config"].get("resolved", {})
-    gb = b["config"].get("resolved", {})
     for key in sorted(set(ga) | set(gb)):
         if ga.get(key) != gb.get(key):
             # Not a divergence by itself, but the first place to look
