@@ -35,6 +35,7 @@ jaxsetup.setup()
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+from gossip_simulator_tpu import tuning as _tuning  # noqa: E402
 from gossip_simulator_tpu.backends.jax_backend import JaxStepper  # noqa: E402
 from gossip_simulator_tpu.backends.native import NativeStepper  # noqa: E402
 from gossip_simulator_tpu.config import Config  # noqa: E402
@@ -209,12 +210,23 @@ def _bench_backend(cfg: Config, time_graph_gen: bool = False) -> dict:
     the sharded-vs-jax 1-chip twins the README projection rests on must
     stay like-for-like, so both go through here.
 
+    The body runs under tuning.ambient(cfg), like driver.run_simulation:
+    cfg-less tunable lookups deeper in the stack (exchange pad/rank
+    path, pallas block rows) resolve THIS row's tuning table instead of
+    registry defaults, so bench evidence measures the same constant
+    resolution a production run of the same config would.
+
     With `time_graph_gen`, steady-state graph generation is timed
     separately (first-call init is tracing + compile + generate; the
     regeneration shows the cached-executable cost) -- skipped at
     100M-scale where it would hold a SECOND friends table (2.4 GB at
     1e8 x 6) alongside the live state; transient peaks like that are
     what crashed the r2 fanout-6 attempts on the 16 GB v5e."""
+    with _tuning.ambient(cfg):
+        return _bench_backend_body(cfg, time_graph_gen)
+
+
+def _bench_backend_body(cfg: Config, time_graph_gen: bool) -> dict:
     from gossip_simulator_tpu.backends import make_stepper
     from gossip_simulator_tpu.models import graphs
 

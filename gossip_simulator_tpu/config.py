@@ -544,7 +544,8 @@ class Config:
 
     @property
     def tuning_entry_resolved(self) -> str:
-        """Active tuning-table entry id, or "defaults" -- resolved LAZILY
+        """Active tuning-table entry id(s, "+"-joined when several
+        spaces match), or "defaults" -- resolved LAZILY
         (table matching keys on the jax platform fingerprint, so the
         lookup happens post-setup like deliver_kernel_resolved; validate()
         must not import jax).  Never raises: any table-resolution error
@@ -585,11 +586,12 @@ class Config:
                 gates["deliver_kernel"] = "unavailable"
         else:
             gates["deliver_kernel"] = None
-        # The active tuning-table entry id ("defaults" when no table
+        # The active tuning-table entry ids ("defaults" when no table
         # matches): a table CAN carry trajectory-affecting values (it is
-        # reviewed, committed data -- autotune itself only persists
-        # neutral-by-contract tunables), so compare_runs names a mismatch
-        # here as the first divergence suspect.
+        # reviewed, committed data -- autotune itself persists only
+        # contract-neutral tunables band-wide, and gate-validated ones
+        # behind a matching workload-shape key), so compare_runs names a
+        # mismatch here as the first divergence suspect.
         gates["tuning_table"] = self.tuning_entry_resolved
         return gates
 

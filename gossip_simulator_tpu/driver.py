@@ -87,17 +87,17 @@ def _run(cfg: Config, printer: ProgressPrinter,
             f"overlay-heal {cfg.overlay_heal}"
             + (f" (detect {cfg.heal_detect_ms}ms)"
                if cfg.overlay_heal_resolved else ""))
-    entry = _tuning.entry_for(cfg)
-    if entry is not None and any(
-            v != _tuning.REGISTRY[k].default
-            for k, v in entry.get("values", {}).items()
-            if k in _tuning.REGISTRY):
-        # Same self-describing-transcript rationale as the scenario banner:
-        # a run whose constants were MOVED by a table entry says which one.
-        # An all-defaults entry stays silent -- it produces the identical
-        # program, and the golden transcripts pin that.
-        printer.note(f"tuning: table entry {entry['id']} active "
-                     f"(table {cfg.tuning_table})")
+    for entry in _tuning.entries_for(cfg):
+        if any(v != _tuning.REGISTRY[k].default
+               for k, v in entry.get("values", {}).items()
+               if k in _tuning.REGISTRY):
+            # Same self-describing-transcript rationale as the scenario
+            # banner: a run whose constants were MOVED by a table entry
+            # says which one.  An all-defaults entry stays silent -- it
+            # produces the identical program, and the golden transcripts
+            # pin that.
+            printer.note(f"tuning: table entry {entry['id']} active "
+                         f"(table {cfg.tuning_table})")
     t_init = time.perf_counter()
     with _trace.span("init", cat="phase"):
         stepper.init()

@@ -253,6 +253,16 @@ def ring_tail(cfg: Config, n_local: int | None = None) -> int:
     return max(ccap, lanes)
 
 
+def drain_geometry(cfg: Config, n_local: int | None = None) -> tuple:
+    """(slot_cap, drain_chunk, ring_tail): every jit-time ring/drain
+    constant the event-engine tunables (drain_chunk_*, slot_headroom)
+    feed.  This is the autotuner's effect probe (tuning.effective_value):
+    a candidate that leaves this tuple unchanged compiles the identical
+    program, so its sweep row is unexercised noise."""
+    return (slot_cap(cfg, n_local), drain_chunk(cfg, n_local),
+            ring_tail(cfg, n_local))
+
+
 def drain_chunk(cfg: Config, n_local: int | None = None) -> int:
     """Drain chunk size: auto = a degree-scaled n/128 ramp with
     r = mean_degree / 4 (the fanout-3 kout calibration; max_degree 4

@@ -16,8 +16,11 @@ makes that hand-tuned surface a declared, searchable parameter space:
   them).  The ``chunk_ladder`` space folds in the deleted
   ``scripts/chunk_sweep.py`` / ``chunk_sweep_f6.py`` candidate ladders.
 * Tuning tables -- committed JSON (``TUNING_TABLE.json`` at the repo
-  root) keyed by (platform, device_kind, scale band).  ``Config``
-  consults the matching entry at build time; the resolution order is
+  root) keyed by (platform, device_kind, scale band), plus a workload
+  shape for entries carrying gate-validated tunables.  ``Config``
+  consults EVERY matching entry at build time (entries from different
+  spaces coexist in one band without shadowing each other); the
+  resolution order per tunable is
 
       explicit CLI flag (checked at the call site, e.g. -compact-chunk,
           -event-chunk, -event-slot-cap)
@@ -25,19 +28,32 @@ makes that hand-tuned surface a declared, searchable parameter space:
     > active tuning-table entry (-tuning-table auto|off|PATH)
     > registered / module default.
 
-The active entry id (or ``"defaults"``) is stamped into
-``Config.resolved_gates()`` and hence every run-dir ``config.json`` and
-terminal ``result`` record, so ``scripts/compare_runs.py`` can name a
-table mismatch as the first divergence suspect.
+The ``+``-joined ids of every active entry (or ``"defaults"``) are
+stamped into ``Config.resolved_gates()`` and hence every run-dir
+``config.json`` and terminal ``result`` record, so
+``scripts/compare_runs.py`` can name a table mismatch as the first
+divergence suspect.
 
 Correctness contract: ``scripts/autotune.py`` rejects ANY candidate
 whose run-dir trajectory fingerprint differs from the default-constants
-twin (the neutrality gate -- perf search can never change results), and
-only tunables declared ``neutral=True`` (trajectory-neutral at ANY shape
-by contract, e.g. chunk widths under the rank-continuation delivery
-contract) are ever persisted to a table: a gate pass at the swept shape
-does not transfer to other shapes for capacity-like constants
-(slot_headroom, chernoff_pad), so their sweeps are timing evidence only.
+twin (the neutrality gate -- perf search can never change results).
+What a passed gate is worth differs per tunable, so each one declares a
+``persist`` class:
+
+* ``"contract"`` -- trajectory-neutral at ANY shape by construction
+  (chunk widths under the rank-continuation delivery contract, the
+  bit-identical rank-path width): a gate pass is confirmation, and a
+  winner persists band-wide.
+* ``"gated"`` -- trajectory-affecting in principle (the event drain
+  chunk: a chunk-boundary re-broadcast uses the first-encountered
+  delivery tick, models/event.py), so a gate pass at one shape does NOT
+  transfer.  A winner persists only after the gate also passes at extra
+  probe shapes (other seeds / other n in the band), and its entry
+  carries the swept workload shape (:func:`workload_shape`): the values
+  apply only to runs matching that shape, never band-wide.
+* ``"never"`` -- capacity or semantics constants (slot_headroom,
+  chernoff_pad, spill_margin, the Pallas PRNG block height): sweeps are
+  timing evidence only, nothing is ever persisted.
 
 This module imports no jax at import time; platform resolution is lazy
 (first table lookup), keeping ``Config.validate()`` jax-free.
@@ -47,13 +63,18 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
+import importlib
 import json
 import os
 from typing import Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 COMMITTED_TABLE = os.path.join(REPO_ROOT, "TUNING_TABLE.json")
-TABLE_SCHEMA = 1
+# Schema 2: entries may carry a "shape" key (required for entries whose
+# values include persist="gated" tunables) and several entries can be
+# active at once (one per space).
+TABLE_SCHEMA = 2
 
 # Scale bands keying table entries: a winner measured at one n applies
 # to the band it was swept in, not the whole axis (per-op floors vs
@@ -74,106 +95,125 @@ def scale_band(n: int) -> str:
 @dataclasses.dataclass(frozen=True)
 class Tunable:
     """One registered constant: where it lives, what it may legally be,
-    and whether a swept winner is table-eligible (see module docstring)."""
+    and how a swept winner may persist (see module docstring)."""
 
     name: str  # "module.constant", the registry key
     module: str  # home module (dotted path, for docs/provenance)
     default: float  # bit-identical to the constant it replaced
     candidates: tuple  # legal sweep ladder (default always included)
     kind: type  # int or float
-    neutral: bool  # trajectory-neutral at ANY shape by contract
+    persist: str  # "contract" | "gated" | "never" (module docstring)
     provenance: str  # PROFILE_*/BENCH_* artifact the default came from
     shapes: str  # workload shapes the constant affects
     cfg_field: str = ""  # explicit Config field that outranks everything
+    # "module:function" computing the DERIVED constant the tunable feeds
+    # at a given cfg (e.g. event.drain_chunk).  The autotuner compares it
+    # under override vs default and skips candidates that cannot change
+    # the compiled program at the swept shape ("unexercised" -- their
+    # timing deltas would be pure noise).  Empty = the value itself.
+    effect: str = ""
 
 
+PERSIST_CLASSES = ("contract", "gated", "never")
 REGISTRY: dict[str, Tunable] = {}
 
 
 def _register(name: str, module: str, default, candidates, kind,
-              neutral: bool, provenance: str, shapes: str,
-              cfg_field: str = "") -> None:
+              persist: str, provenance: str, shapes: str,
+              cfg_field: str = "", effect: str = "") -> None:
+    assert persist in PERSIST_CLASSES, (name, persist)
     cands = tuple(sorted(set(tuple(candidates) + (default,))))
     REGISTRY[name] = Tunable(name=name, module=module, default=default,
-                             candidates=cands, kind=kind, neutral=neutral,
+                             candidates=cands, kind=kind, persist=persist,
                              provenance=provenance, shapes=shapes,
-                             cfg_field=cfg_field)
+                             cfg_field=cfg_field, effect=effect)
 
 
 # --- the hand-tuned constant surface (defaults bit-identical) --------------
 _register("overlay.delivery_chunk_base", "gossip_simulator_tpu.models.overlay",
-          65_536, (32_768, 65_536, 131_072, 262_144), int, True,
+          65_536, (32_768, 65_536, 131_072, 262_144), int, "contract",
           "PROFILE_OVERLAY.json",
           "rounds-overlay mailbox delivery (v5e full-construction sweep "
           "optimum at n=1e6)", cfg_field="compact_chunk")
 _register("overlay.delivery_chunk_cap", "gossip_simulator_tpu.models.overlay",
-          1_048_576, (524_288, 1_048_576, 2_097_152), int, True,
+          1_048_576, (524_288, 1_048_576, 2_097_152), int, "contract",
           "PROFILE_OVERLAY.json",
           "rounds-overlay delivery n/128 ramp ceiling (>=128M rows)",
           cfg_field="compact_chunk")
 _register("overlay.adaptive_chunk_max", "gossip_simulator_tpu.models.overlay",
-          8_388_608, (2_097_152, 4_194_304, 8_388_608, 16_777_216), int, True,
-          "PROFILE_OVERLAY.json",
+          8_388_608, (2_097_152, 4_194_304, 8_388_608, 16_777_216), int,
+          "contract", "PROFILE_OVERLAY.json",
           "fattest rung of the occupancy-adaptive hosted-chunk ladder "
           "(split-round band, >=32M rows)")
 _register("overlay.spill_margin", "gossip_simulator_tpu.models.overlay",
-          1.6, (1.2, 1.6, 2.0, 2.5), float, False,
+          1.6, (1.2, 1.6, 2.0, 2.5), float, "never",
           "BENCH_SELF_r07.json",
           "static-boot burst spill sizing (cap-8 band); too small drops "
           "messages -- capacity, not chunking, so never table-persisted")
 _register("overlay_ticks.delivery_chunk_cap",
           "gossip_simulator_tpu.models.overlay_ticks",
-          2_097_152, (1_048_576, 2_097_152, 4_194_304), int, True,
+          2_097_152, (1_048_576, 2_097_152, 4_194_304), int, "contract",
           "PROFILE_OVERLAY.json",
           "ticks-overlay slot-drain chunk ceiling (re-swept 2026-07-31 "
           "at 10M)", cfg_field="compact_chunk")
 _register("exchange.rank_max_shards",
           "gossip_simulator_tpu.parallel.exchange",
-          16, (8, 16, 32, 64), int, True,
+          16, (8, 16, 32, 64), int, "contract",
           "PROFILE_EXCHANGE.json",
           "widest mesh served by the sort-free one-hot bucketing rank "
           "(both paths bit-identical; pinned by test_sharded)")
 _register("exchange.chernoff_pad", "gossip_simulator_tpu.parallel.exchange",
-          8, (6, 8, 10, 12), int, False,
+          8, (6, 8, 10, 12), int, "never",
           "PROFILE_EXCHANGE.json",
           "wire-cap pad multiplier (pad = max(64, k*sqrt(mean))); smaller "
           "raises overflow odds -- capacity, never table-persisted")
 _register("event.slot_headroom", "gossip_simulator_tpu.models.event",
-          1.5, (1.25, 1.5, 2.0), float, False,
+          1.5, (1.25, 1.5, 2.0), float, "never",
           "BENCH_SELF_r05.json",
           "event mail-ring slot-cap skew headroom; too small overflows "
           "(counted, and the neutrality gate rejects it) -- capacity, "
-          "never table-persisted", cfg_field="event_slot_cap")
+          "never table-persisted", cfg_field="event_slot_cap",
+          effect="gossip_simulator_tpu.models.event:drain_geometry")
+# The four drain-chunk knobs are persist="gated", NOT contract-neutral:
+# a window draining in multiple chunks re-broadcasts a boundary-spanning
+# node from its first-ENCOUNTERED delivery tick (models/event.py module
+# docstring), so a different effective chunk can move the trajectory.
+# The gate catches that at the swept shape; persistence additionally
+# requires cross-shape probe passes and shape-keyed table entries.
 _register("event.drain_chunk_floor", "gossip_simulator_tpu.models.event",
-          131_072, (32_768, 65_536, 131_072, 262_144, 524_288), int, True,
+          131_072, (32_768, 65_536, 131_072, 262_144, 524_288), int, "gated",
           "BENCH_SELF_r03.json",
           "event drain-chunk auto ramp floor (dominant term below "
-          "n ~ 16M)", cfg_field="event_chunk")
+          "n ~ 16M)", cfg_field="event_chunk",
+          effect="gossip_simulator_tpu.models.event:drain_geometry")
 _register("event.drain_chunk_hi", "gossip_simulator_tpu.models.event",
-          1_048_576, (262_144, 524_288, 1_048_576, 2_097_152), int, True,
+          1_048_576, (262_144, 524_288, 1_048_576, 2_097_152), int, "gated",
           "BENCH_SELF_r05.json",
           "event drain-chunk ceiling, mean_degree/4 >= 1.5 (the fanout-6 "
-          "ladder scripts/chunk_sweep_f6.py swept)", cfg_field="event_chunk")
+          "ladder scripts/chunk_sweep_f6.py swept)", cfg_field="event_chunk",
+          effect="gossip_simulator_tpu.models.event:drain_geometry")
 _register("event.drain_chunk_hi_lowdeg", "gossip_simulator_tpu.models.event",
-          524_288, (524_288, 1_048_576, 2_097_152, 4_194_304), int, True,
+          524_288, (524_288, 1_048_576, 2_097_152, 4_194_304), int, "gated",
           "BENCH_SELF_r03.json",
           "event drain-chunk ceiling, low-degree branch (the fanout-3 "
-          "ladder scripts/chunk_sweep.py swept)", cfg_field="event_chunk")
+          "ladder scripts/chunk_sweep.py swept)", cfg_field="event_chunk",
+          effect="gossip_simulator_tpu.models.event:drain_geometry")
 _register("event.drain_chunk_hi_suppress",
           "gossip_simulator_tpu.models.event",
-          4_194_304, (1_048_576, 2_097_152, 4_194_304, 8_388_608), int, True,
-          "BENCH_SELF_r06.json",
+          4_194_304, (1_048_576, 2_097_152, 4_194_304, 8_388_608), int,
+          "gated", "BENCH_SELF_r06.json",
           "event drain-chunk ceiling under duplicate suppression (1e8 "
-          "fanout-6 sweep 2026-07-31)", cfg_field="event_chunk")
+          "fanout-6 sweep 2026-07-31)", cfg_field="event_chunk",
+          effect="gossip_simulator_tpu.models.event:drain_geometry")
 _register("pallas_graph.block_rows", "gossip_simulator_tpu.ops.pallas_graph",
-          512, (256, 512, 1024, 2048), int, False,
+          512, (256, 512, 1024, 2048), int, "never",
           "PALLAS_VALIDATION.json",
           "Pallas graph-generator grid block; NOT neutral: the TPU PRNG "
           "seeds per block (row0 // block + blk), so a different block "
           "height generates a different graph -- the gate always rejects "
           "alternatives")
 _register("config.overlay_ticks_auto_max", "gossip_simulator_tpu.config",
-          10_000_000, (1_000_000, 10_000_000), int, False,
+          10_000_000, (1_000_000, 10_000_000), int, "never",
           "BENCH_SELF_r07.json",
           "overlay_mode auto band: switches the phase-1 engine (true vs "
           "estimated stabilization clock) -- semantics, never "
@@ -281,6 +321,43 @@ def ambient(cfg):
         _AMBIENT.pop()
 
 
+# The Config fields that pin a table entry's workload shape (raw field
+# values, not resolved properties: deterministic, jax-free, and JSON
+# round-trip stable).  n and seed are deliberately absent -- the scale
+# band covers n, and the cross-shape probe gate in scripts/autotune.py
+# varies exactly those two axes before a gated winner may persist.
+SHAPE_FIELDS = ("backend", "engine", "graph", "protocol", "fanout", "fanin",
+                "delaylow", "delayhigh", "crashrate", "rumors",
+                "dup_suppress")
+
+
+def workload_shape(cfg) -> dict:
+    """The shape key stamped into (and matched against) table entries
+    carrying persist="gated" tunables."""
+    return {f: getattr(cfg, f) for f in SHAPE_FIELDS}
+
+
+def shape_digest(shape: dict) -> str:
+    """Short stable digest of a shape key (entry-id component, so two
+    sweeps of the same space at different workloads coexist)."""
+    raw = json.dumps(shape, sort_keys=True).encode()
+    return hashlib.sha256(raw).hexdigest()[:8]
+
+
+def effective_value(name: str, cfg):
+    """The derived constant the tunable actually feeds at `cfg` (the
+    registered ``effect`` function, e.g. event.drain_chunk), or the
+    resolved value itself when no effect is declared.  The autotuner
+    compares this under override vs default: a candidate that cannot
+    change it at the swept shape ran the identical program, so its
+    timing delta is noise and its neutrality verdict vacuous."""
+    t = REGISTRY[name]
+    if not t.effect:
+        return value(name, cfg)
+    mod_name, _, fn_name = t.effect.partition(":")
+    return getattr(importlib.import_module(mod_name), fn_name)(cfg)
+
+
 def table_path(cfg) -> Optional[str]:
     """Resolve -tuning-table: "off" -> None, "auto" -> the committed
     table when present, else the explicit path."""
@@ -309,50 +386,84 @@ def load_table(path: str) -> dict:
         for field in ("id", "platform", "scale_band", "values"):
             if field not in e:
                 raise ValueError(f"{path}: entry missing {field!r}: {e}")
+        gated = [k for k in e["values"]
+                 if k in REGISTRY and REGISTRY[k].persist == "gated"]
+        if gated and "shape" not in e:
+            # A gated value with no shape key would apply band-wide --
+            # exactly the transfer the persist taxonomy forbids.  Failing
+            # the load degrades every consumer to defaults (entries_for
+            # swallows the error), never to a mis-applied constant.
+            raise ValueError(f"{path}: entry {e['id']!r} carries gated "
+                             f"tunables {gated} without a workload shape")
     _TABLE_CACHE.clear()  # one live table per path in practice
     _TABLE_CACHE[key] = doc
     return doc
 
 
+_PLATFORM_CACHE: Optional[tuple[str, str]] = None
+
+
 def _platform() -> tuple[str, str]:
     """(backend_platform, device_kind) -- the env.json fingerprint's
     fields a table entry keys on.  Lazy jax import (post-setup paths
-    only; Config.validate() never reaches here)."""
-    import jax
+    only; Config.validate() never reaches here); cached, since every
+    tunable read resolves it."""
+    global _PLATFORM_CACHE
+    if _PLATFORM_CACHE is None:
+        import jax
 
-    devs = jax.devices()
-    kind = getattr(devs[0], "device_kind", "") if devs else ""
-    return jax.default_backend(), str(kind)
+        devs = jax.devices()
+        kind = getattr(devs[0], "device_kind", "") if devs else ""
+        _PLATFORM_CACHE = (jax.default_backend(), str(kind))
+    return _PLATFORM_CACHE
 
 
-def entry_for(cfg) -> Optional[dict]:
-    """The matching table entry for this config's platform + scale band,
-    or None (no table, no match, or any resolution error -- a tuning
-    table must never be able to fail a run that would run on defaults)."""
+def entries_for(cfg) -> list[dict]:
+    """ALL table entries matching this config's platform + scale band
+    (+ workload shape, for entries carrying one), sorted by id.  Entries
+    from different spaces coexist -- values are merged across them, not
+    shadowed by whichever happens to match first.  Any resolution error
+    returns [] (a tuning table must never be able to fail a run that
+    would run on defaults)."""
     try:
         path = table_path(cfg)
         if path is None:
-            return None
+            return []
         doc = load_table(path)
         platform, kind = _platform()
         band = scale_band(cfg.n)
+        shape = None
+        out = []
         for e in doc.get("entries", ()):
             if e["platform"] != platform or e["scale_band"] != band:
                 continue
             want_kind = e.get("device_kind", "")
             if want_kind and want_kind != kind:
                 continue
-            return e
+            if "shape" in e:
+                if shape is None:
+                    shape = workload_shape(cfg)
+                if e["shape"] != shape:
+                    continue
+            out.append(e)
+        return sorted(out, key=lambda e: e["id"])
     except Exception:
-        return None
-    return None
+        return []
+
+
+def entry_for(cfg) -> Optional[dict]:
+    """First matching entry or None (driver banner convenience; value()
+    and entry_id() merge across entries_for)."""
+    es = entries_for(cfg)
+    return es[0] if es else None
 
 
 def entry_id(cfg) -> str:
-    """Active tuning-table entry id, or "defaults".  Never raises --
-    stamped by Config.resolved_gates() into every artifact."""
-    e = entry_for(cfg)
-    return e["id"] if e else "defaults"
+    """The "+"-joined ids of every active tuning-table entry, or
+    "defaults".  Never raises -- stamped by Config.resolved_gates() into
+    every artifact, so compare_runs attributes the full constant set."""
+    es = entries_for(cfg)
+    return "+".join(e["id"] for e in es) if es else "defaults"
 
 
 def value(name: str, cfg=None, default=None):
@@ -368,8 +479,13 @@ def value(name: str, cfg=None, default=None):
         return _OVERRIDES[name]
     c = cfg if cfg is not None else (_AMBIENT[-1] if _AMBIENT else None)
     if c is not None:
-        e = entry_for(c)
-        if e is not None and name in e["values"]:
+        for e in entries_for(c):
+            if name not in e.get("values", {}):
+                continue
+            if t.persist == "gated" and "shape" not in e:
+                # Belt under the load_table check: a gated value only
+                # ever applies from a shape-matched entry.
+                continue
             return t.kind(e["values"][name])
     return t.default if default is None else default
 

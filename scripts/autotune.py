@@ -11,18 +11,27 @@ Each candidate value is timed through bench.py's warm+timed protocol
 (`_bench_backend`) with a run-dir artifact per row, and its trajectory
 fingerprint is compared against the default-constants twin measured the
 same way in the same process.  ANY fingerprint mismatch rejects the
-candidate -- the perf search can never change simulation results.  A
-surviving candidate displaces the default only when it wins by
---win-margin (CPU wall clocks are noisy; a tie keeps the shipped
-constant).
+candidate -- the perf search can never change simulation results.
 
-Winners merge into a tuning-table JSON entry keyed by (platform,
-device_kind, scale band, space) -- see gossip_simulator_tpu/tuning.py
-for the schema and the resolution order Config applies.  Only tunables
-registered neutral=True are persisted (capacity-like constants pass the
-gate at ONE shape without that transferring to the rest of the band;
-their sweeps are timing evidence only).  The entry is written even when
-every winner is the default, so a table round-trip is always testable.
+Three further guards keep noise and vacuous verdicts out of the table:
+
+* A candidate whose override cannot change the derived constant at the
+  swept shape (tuning.effective_value: e.g. every drain_chunk_hi* value
+  above the floor-pinned ramp) is marked "unexercised" and never timed
+  -- it would run the identical program, so its timing delta is pure
+  noise and its neutrality verdict vacuous.
+* Every row is timed --repeats times; a candidate displaces the default
+  only when EVERY repeat beats the baseline median by --win-margin
+  (a single-run noise win cannot persist).
+* persist="gated" tunables (the event drain chunks -- trajectory-
+  affecting in principle) additionally re-run the gate at cross-shape
+  probes (another seed, another n in the band) before persisting, and
+  their entry carries the swept workload shape: Config applies the
+  values only to matching workloads, never band-wide.  persist="never"
+  tunables (capacity constants) are timing evidence only.
+
+The entry is written even when every winner is the default, so a table
+round-trip is always testable.
 
 Exit codes: 0 sweep completed (rejections are normal -- that is the gate
 working), 2 usage / environment error.
@@ -33,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import statistics
 import sys
 import tempfile
 
@@ -48,26 +58,53 @@ def _row_name(name: str, value) -> str:
     return f"{name}={value}".replace("/", "_")
 
 
-def _run_candidate(cfg: Config, row: str, overrides: dict,
-                   workdir: str) -> dict:
+def _run_candidate(cfg: Config, row: str, overrides: dict, workdir: str,
+                   repeats: int = 1, expect_fp: str | None = None) -> dict:
     """One measured row: bench warm+timed protocol under the candidate's
-    override context, artifact written to workdir/<row>/.  Returns the
-    bench row dict plus the run-dir fingerprint (pool failures come back
-    as bench skip records -- recorded, not fatal, so a flaky TPU pool
-    costs one candidate, not the sweep)."""
-    with tuning.override(overrides):
-        rec = bench.pool_retry(bench._bench_backend, cfg, name=row)
-    if rec.get("skipped"):
-        return rec
-    with open(os.path.join(workdir, row, "result.json")) as fh:
-        rec["fingerprint"] = json.load(fh)["fingerprint"]
+    override context, `repeats` times, artifact written to workdir/<row>/
+    (last repeat wins the artifact).  Returns the bench row dict plus
+    run_s (median of runs_s) and the per-repeat fingerprints (pool
+    failures come back as bench skip records -- recorded, not fatal, so
+    a flaky TPU pool costs one candidate, not the sweep).  With
+    `expect_fp`, repeats stop at the first mismatching fingerprint --
+    the row is already rejected, further timing is waste."""
+    runs, fps = [], []
+    rec = {}
+    for _ in range(max(1, repeats)):
+        with tuning.override(overrides):
+            rec = bench.pool_retry(bench._bench_backend, cfg, name=row)
+        if rec.get("skipped"):
+            return rec
+        with open(os.path.join(workdir, row, "result.json")) as fh:
+            fps.append(json.load(fh)["fingerprint"])
+        runs.append(rec["run_s"])
+        if expect_fp is not None and fps[-1] != expect_fp:
+            break
+    rec["runs_s"] = runs
+    rec["run_s"] = statistics.median(runs)
+    rec["fingerprints"] = fps
+    rec["fingerprint"] = fps[-1]
     return rec
+
+
+def _probe_shapes(n: int, seed: int, band: str) -> list[tuple[int, int]]:
+    """Cross-shape probe points for gated winners: another seed at the
+    swept n, plus another n inside the same scale band when one exists.
+    (Shape-key fields like fanout/graph never vary here -- the table
+    entry pins those; the probes cover exactly the axes the key does
+    not, n-within-band and seed.)"""
+    shapes = [(n, seed + 1)]
+    for n2 in (n // 2, n * 2, n // 4):
+        if n2 >= 2048 and n2 != n and tuning.scale_band(n2) == band:
+            shapes.append((n2, seed))
+            break
+    return shapes
 
 
 def _merge_entry(table_file: str, entry: dict) -> None:
     """Replace-or-append the entry keyed by (platform, device_kind,
-    scale_band, space); atomic write, entries sorted by id for stable
-    diffs of the committed table."""
+    scale_band, space, shape); atomic write, entries sorted by id for
+    stable diffs of the committed table."""
     doc = {"schema": tuning.TABLE_SCHEMA, "entries": []}
     if os.path.exists(table_file):
         with open(table_file) as fh:
@@ -75,10 +112,12 @@ def _merge_entry(table_file: str, entry: dict) -> None:
         if doc.get("schema") != tuning.TABLE_SCHEMA:
             raise SystemExit(f"{table_file}: schema {doc.get('schema')!r} "
                              f"!= {tuning.TABLE_SCHEMA}")
-    key = ("platform", "device_kind", "scale_band", "space")
+    def key(e):
+        return (tuple(e.get(k) for k in
+                      ("platform", "device_kind", "scale_band", "space"))
+                + (json.dumps(e.get("shape"), sort_keys=True),))
     doc["entries"] = [e for e in doc.get("entries", ())
-                      if tuple(e.get(k) for k in key)
-                      != tuple(entry[k] for k in key)]
+                      if key(e) != key(entry)]
     doc["entries"].append(entry)
     doc["entries"].sort(key=lambda e: e["id"])
     tmp = table_file + ".tmp"
@@ -92,7 +131,7 @@ def sweep_space(space_name: str, n: int, seed: int = 3,
                 table_file: str | None = None, workdir: str | None = None,
                 tunable: str | None = None, candidates: list | None = None,
                 plant: tuple | None = None, win_margin: float = 0.03,
-                log=print) -> dict:
+                repeats: int = 2, log=print) -> dict:
     """Run one space's coordinate-wise sweep at (n, seed) on the current
     platform; persist the entry to `table_file` (None skips persistence).
     Callable from tests and bench captures; returns the summary dict."""
@@ -120,13 +159,8 @@ def sweep_space(space_name: str, n: int, seed: int = 3,
     bench._RUN_DIR_ROOT = workdir
     try:
         log(f"[autotune] space={space_name} n={n} band={band} "
-            f"platform={platform}/{kind or 'any'} workdir={workdir}")
-        base = _run_candidate(cfg, "baseline", {}, workdir)
-        if base.get("skipped"):
-            raise SystemExit(f"baseline run failed: {base.get('error')}")
-        base_fp, base_s = base["fingerprint"], base["run_s"]
-        log(f"[autotune] baseline (defaults): {base_s:.3f}s "
-            f"fingerprint {base_fp}")
+            f"platform={platform}/{kind or 'any'} repeats={repeats} "
+            f"workdir={workdir}")
 
         rows, winners = [], {}
         todo = []
@@ -138,16 +172,53 @@ def sweep_space(space_name: str, n: int, seed: int = 3,
         if plant:
             todo.append(plant)
 
+        # Pre-flight: drop candidates that cannot change the derived
+        # constant at this shape (e.g. a drain_chunk_hi above the
+        # floor-pinned ramp) -- they would run the identical program, so
+        # their "neutral" verdict is vacuous and their timing pure noise.
+        runnable = []
         for name, v in todo:
+            eff_def = tuning.effective_value(name, cfg)
+            with tuning.override({name: v}):
+                eff = tuning.effective_value(name, cfg)
+            if eff == eff_def:
+                rows.append({"tunable": name, "value": v,
+                             "verdict": "unexercised"})
+                log(f"[autotune]   {_row_name(name, v)}: UNEXERCISED "
+                    f"(derived constant stays {eff_def} at this shape; "
+                    f"not timed)")
+            else:
+                runnable.append((name, v))
+
+        base_fp, base_s = None, None
+        if runnable:
+            base = _run_candidate(cfg, "baseline", {}, workdir,
+                                  repeats=repeats)
+            if base.get("skipped"):
+                raise SystemExit(f"baseline run failed: {base.get('error')}")
+            if len(set(base["fingerprints"])) != 1:
+                raise SystemExit(
+                    f"baseline fingerprints differ across repeats "
+                    f"({base['fingerprints']}): platform is "
+                    f"nondeterministic, no neutrality gate possible")
+            base_fp, base_s = base["fingerprint"], base["run_s"]
+            log(f"[autotune] baseline (defaults): {base_s:.3f}s over "
+                f"{len(base['runs_s'])} runs, fingerprint {base_fp}")
+        else:
+            log("[autotune] every candidate is unexercised at this shape: "
+                "nothing to time, defaults retained")
+
+        for name, v in runnable:
             row = _row_name(name, v)
-            rec = _run_candidate(cfg, row, {name: v}, workdir)
+            rec = _run_candidate(cfg, row, {name: v}, workdir,
+                                 repeats=repeats, expect_fp=base_fp)
             if rec.get("skipped"):
                 rows.append({"tunable": name, "value": v,
                              "verdict": "error", "error": rec.get("error")})
                 log(f"[autotune]   {row}: ERROR {rec.get('error')}")
                 continue
             fp, run_s = rec["fingerprint"], rec["run_s"]
-            if fp != base_fp:
+            if any(f != base_fp for f in rec["fingerprints"]):
                 # THE neutrality gate: a candidate that moved the
                 # trajectory is out, however fast it ran.
                 rows.append({"tunable": name, "value": v, "run_s": run_s,
@@ -157,16 +228,53 @@ def sweep_space(space_name: str, n: int, seed: int = 3,
                     f"default-constants twin {base_fp})")
                 continue
             rows.append({"tunable": name, "value": v, "run_s": run_s,
+                         "runs_s": [round(r, 4) for r in rec["runs_s"]],
                          "fingerprint": fp, "verdict": "neutral"})
-            log(f"[autotune]   {row}: {run_s:.3f}s fingerprint match")
+            log(f"[autotune]   {row}: {run_s:.3f}s (median of "
+                f"{len(rec['runs_s'])}) fingerprint match")
             best = winners.get(name)
+            # EVERY repeat must clear the margin against the baseline
+            # median: a single-run noise spike cannot crown a winner.
             if ((best is None or run_s < best[1])
-                    and run_s < base_s * (1.0 - win_margin)):
+                    and all(r < base_s * (1.0 - win_margin)
+                            for r in rec["runs_s"])):
                 winners[name] = (v, run_s)
+
+        # Cross-shape probe gate: a gated winner's neutrality at the
+        # swept shape does not transfer, so re-run the gate at the probe
+        # shapes (other seed / other n in the band) before it may
+        # persist.  Probe baselines are shared across winners.
+        probe_base: dict = {}
+        for name in [k for k in winners
+                     if tuning.REGISTRY[k].persist == "gated"]:
+            v = winners[name][0]
+            ok = True
+            for pn, ps in _probe_shapes(n, seed, band):
+                pcfg = cfg.replace(n=pn, seed=ps).validate()
+                if (pn, ps) not in probe_base:
+                    probe_base[(pn, ps)] = _run_candidate(
+                        pcfg, f"probe_n{pn}_s{ps}_baseline", {}, workdir)
+                pb = probe_base[(pn, ps)]
+                pc = _run_candidate(
+                    pcfg, f"{_row_name(name, v)}_probe_n{pn}_s{ps}",
+                    {name: v}, workdir, expect_fp=pb.get("fingerprint"))
+                if (pb.get("skipped") or pc.get("skipped")
+                        or pc["fingerprint"] != pb["fingerprint"]):
+                    ok = False
+                    rows.append({"tunable": name, "value": v,
+                                 "probe": {"n": pn, "seed": ps},
+                                 "verdict": "rejected_probe"})
+                    log(f"[autotune]   {_row_name(name, v)}: REJECTED by "
+                        f"cross-shape probe (n={pn}, seed={ps}) -- gate "
+                        f"pass at the swept shape does not transfer")
+                    break
+            if not ok:
+                del winners[name]
     finally:
         bench._RUN_DIR_ROOT = prev_root
 
     persisted = {}
+    shape_needed = False
     for name in names:
         t = tuning.REGISTRY[name]
         won = winners.get(name)
@@ -174,19 +282,26 @@ def sweep_space(space_name: str, n: int, seed: int = 3,
         log(f"[autotune] winner {name} = {value}"
             + (f" ({won[1]:.3f}s vs default {base_s:.3f}s)" if won
                else " (default retained)"))
-        if t.neutral:
-            persisted[name] = value
-        elif won:
-            log(f"[autotune]   {name} is neutral=False: timing evidence "
-                f"only, not persisted")
+        if t.persist == "never":
+            if won:
+                log(f"[autotune]   {name} is persist=never: timing "
+                    f"evidence only, not persisted")
+            continue
+        persisted[name] = value
+        if t.persist == "gated":
+            shape_needed = True
 
+    shape = tuning.workload_shape(cfg) if shape_needed else None
     entry_id = f"{platform}/{kind or 'any'}/{band}/{space_name}"
+    if shape is not None:
+        entry_id += f"/{tuning.shape_digest(shape)}"
     summary = {
         "space": space_name, "n": n, "seed": seed, "band": band,
         "platform": platform, "device_kind": kind,
-        "baseline": {"run_s": round(base_s, 4), "fingerprint": base_fp},
+        "baseline": {"run_s": base_s, "fingerprint": base_fp},
         "rows": rows,
-        "rejected": [r for r in rows if r["verdict"] == "rejected"],
+        "rejected": [r for r in rows
+                     if r["verdict"] in ("rejected", "rejected_probe")],
         "winners": {k: v[0] for k, v in winners.items()},
         "persisted": persisted, "entry_id": entry_id, "table": table_file,
     }
@@ -196,13 +311,17 @@ def sweep_space(space_name: str, n: int, seed: int = 3,
             "scale_band": band, "space": space_name, "values": persisted,
             "evidence": {
                 "n": n, "seed": seed,
-                "baseline_run_s": round(base_s, 4),
-                "win_margin": win_margin,
+                "baseline_run_s": (round(base_s, 4)
+                                   if base_s is not None else None),
+                "win_margin": win_margin, "repeats": repeats,
                 "rows": [{k: (round(r[k], 4) if k == "run_s" else r[k])
-                          for k in ("tunable", "value", "run_s", "verdict")
+                          for k in ("tunable", "value", "run_s", "runs_s",
+                                    "probe", "verdict")
                           if k in r} for r in rows],
             },
         }
+        if shape is not None:
+            entry["shape"] = shape
         _merge_entry(table_file, entry)
         log(f"[autotune] persisted entry {entry_id} -> {table_file}")
     return summary
@@ -232,6 +351,9 @@ def main(argv=None) -> int:
     p.add_argument("--win-margin", type=float, default=0.03,
                    help="fraction a candidate must beat the default by to "
                         "displace it (default 0.03)")
+    p.add_argument("--repeats", type=int, default=2,
+                   help="timed runs per row; every repeat must clear "
+                        "--win-margin for a candidate to win (default 2)")
     args = p.parse_args(argv)
 
     cands = None
@@ -251,7 +373,8 @@ def main(argv=None) -> int:
     summary = sweep_space(args.space, args.n, seed=args.seed,
                           table_file=table, workdir=args.workdir,
                           tunable=args.tunable, candidates=cands,
-                          plant=plant, win_margin=args.win_margin)
+                          plant=plant, win_margin=args.win_margin,
+                          repeats=args.repeats)
     log_rej = len(summary["rejected"])
     print(f"[autotune] done: {len(summary['rows'])} candidates, "
           f"{log_rej} rejected by the neutrality gate, persisted "

@@ -10,8 +10,10 @@ Three layers of protection around the tuned-constant surface:
   (slot_headroom=0.01 collapses the mail-ring cap -> counted drops ->
   trajectory divergence) must come back rejected and logged;
 * the persistence round-trip: a swept winner lands in a table entry
-  that Config resolves (resolved_gates names the entry id) and
-  tuning.value returns, and scripts/compare_runs.py names a
+  that Config resolves (resolved_gates names every active entry id) and
+  tuning.value returns, persist="gated" values apply only behind a
+  matching workload-shape key, entries from different spaces merge
+  instead of shadowing, and scripts/compare_runs.py names a
   tuning-table mismatch FIRST when fingerprints diverge.
 """
 
@@ -164,7 +166,7 @@ def test_sweep_rejects_planted_candidate_and_persists_winner(tmp_path):
     assert planted and planted[0]["verdict"] == "rejected", summary["rows"]
     assert any("REJECTED" in line and "slot_headroom" in line
                for line in logs), logs
-    # slot_headroom is neutral=False: even a passing value never persists.
+    # slot_headroom is persist="never": even a passing value never persists.
     assert "event.slot_headroom" not in summary["persisted"]
 
     doc = json.load(open(table))
@@ -173,10 +175,16 @@ def test_sweep_rejects_planted_candidate_and_persists_winner(tmp_path):
     assert entry["space"] == "chunk_ladder"
     assert entry["scale_band"] == "<=1m"
     assert entry["values"], entry
+    # drain_chunk_* are persist="gated": their entry MUST carry the swept
+    # workload shape (values never apply band-wide) and the id its digest.
+    assert entry["shape"] == tuning.workload_shape(
+        Config(n=10_000, tuning_table="off",
+               **tuning.SPACES["chunk_ladder"].workload).validate())
+    assert entry["id"].endswith("/" + tuning.shape_digest(entry["shape"]))
     rejected = {(r["tunable"], r["value"]) for r in summary["rows"]
-                if r["verdict"] == "rejected"}
+                if r["verdict"] in ("rejected", "rejected_probe")}
     for name, v in entry["values"].items():
-        assert tuning.REGISTRY[name].neutral, name
+        assert tuning.REGISTRY[name].persist != "never", name
         assert (name, v) not in rejected, (name, v)
 
     cfg = Config(n=10_000, tuning_table=table,
@@ -189,23 +197,112 @@ def test_sweep_rejects_planted_candidate_and_persists_winner(tmp_path):
     assert big.resolved_gates()["tuning_table"] == "defaults"
 
 
+def _gated_entry(cfg, values, entry_id="t", space="chunk_ladder"):
+    """A schema-valid table entry whose gated values apply to `cfg`."""
+    return {"id": entry_id, "platform": tuning._platform()[0],
+            "device_kind": "", "scale_band": tuning.scale_band(cfg.n),
+            "space": space, "shape": tuning.workload_shape(cfg),
+            "values": values}
+
+
 def test_explicit_cli_flag_outranks_table(tmp_path):
     """The resolution order's top rung: an explicit -event-chunk short-
     circuits at the call site before any table entry is consulted."""
     from gossip_simulator_tpu.models import event
 
-    table = {"schema": 1, "entries": [{
-        "id": "t", "platform": tuning._platform()[0],
-        "device_kind": "", "scale_band": "<=1m", "space": "chunk_ladder",
-        "values": {"event.drain_chunk_floor": 8192}}]}
+    cfg = Config(n=10_000, fanout=6, graph="kout", backend="jax").validate()
+    table = {"schema": tuning.TABLE_SCHEMA, "entries": [
+        _gated_entry(cfg, {"event.drain_chunk_floor": 8192})]}
     path = tmp_path / "t.json"
     path.write_text(json.dumps(table))
-    cfg = Config(n=10_000, fanout=6, graph="kout", backend="jax",
-                 tuning_table=str(path)).validate()
+    cfg = cfg.replace(tuning_table=str(path)).validate()
     assert tuning.value("event.drain_chunk_floor", cfg) == 8192
     explicit = cfg.replace(event_chunk=65_536).validate()
     assert event.drain_chunk(explicit) == min(
         event.slot_cap(explicit), 65_536)
+
+
+def test_gated_values_require_matching_shape(tmp_path):
+    """A persist="gated" value applies ONLY to the workload shape its
+    sweep validated: a different shape in the same scale band falls back
+    to defaults, and a shapeless gated entry disables the whole table
+    (load_table refuses it -- fail toward defaults, never toward a
+    mis-applied constant)."""
+    cfg = Config(n=10_000, fanout=6, graph="kout", backend="jax").validate()
+    table = {"schema": tuning.TABLE_SCHEMA, "entries": [
+        _gated_entry(cfg, {"event.drain_chunk_floor": 8192})]}
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(table))
+    match = cfg.replace(tuning_table=str(path)).validate()
+    assert tuning.value("event.drain_chunk_floor", match) == 8192
+    # Same platform, same band, different fanout: shape mismatch.
+    other = match.replace(fanout=3).validate()
+    assert tuning.value("event.drain_chunk_floor", other) == 131_072
+    assert other.resolved_gates()["tuning_table"] == "defaults"
+    # Gated values without a shape key never load.
+    bad = {"schema": tuning.TABLE_SCHEMA, "entries": [{
+        "id": "bad", "platform": tuning._platform()[0], "device_kind": "",
+        "scale_band": "<=1m", "space": "chunk_ladder",
+        "values": {"event.drain_chunk_floor": 8192}}]}
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    with pytest.raises(ValueError):
+        tuning.load_table(str(bad_path))
+    shapeless = cfg.replace(tuning_table=str(bad_path)).validate()
+    assert tuning.value("event.drain_chunk_floor", shapeless) == 131_072
+    assert shapeless.resolved_gates()["tuning_table"] == "defaults"
+
+
+def test_entries_merge_across_spaces_without_shadowing(tmp_path):
+    """Two spaces persisted for the same (platform, band) must BOTH
+    resolve: values merge across entries and resolved_gates stamps every
+    active entry id (regression: first-match lookup let one space's
+    entry shadow the other back to defaults)."""
+    cfg = Config(n=10_000, fanout=6, graph="kout", backend="jax").validate()
+    table = {"schema": tuning.TABLE_SCHEMA, "entries": [
+        _gated_entry(cfg, {"event.drain_chunk_floor": 8192},
+                     entry_id="a/chunk_ladder"),
+        {"id": "b/overlay_chunk", "platform": tuning._platform()[0],
+         "device_kind": "", "scale_band": "<=1m", "space": "overlay_chunk",
+         "values": {"overlay.delivery_chunk_base": 32_768}}]}
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(table))
+    cfg = cfg.replace(tuning_table=str(path)).validate()
+    assert tuning.value("event.drain_chunk_floor", cfg) == 8192
+    assert tuning.value("overlay.delivery_chunk_base", cfg) == 32_768
+    assert (cfg.resolved_gates()["tuning_table"]
+            == "a/chunk_ladder+b/overlay_chunk")
+
+
+def test_unexercised_candidates_are_not_timed(tmp_path):
+    """A candidate whose override cannot change the derived constant at
+    the swept shape (drain_chunk_hi above the floor-pinned ramp) must be
+    flagged unexercised and skipped -- its neutrality verdict would be
+    vacuous and a noise 'win' could persist an unvalidated value."""
+    mod = _load_autotune()
+    logs = []
+    summary = mod.sweep_space(
+        "chunk_ladder", 10_000, seed=3, table_file=None,
+        workdir=str(tmp_path / "runs"),
+        tunable="event.drain_chunk_hi", candidates=[2_097_152],
+        log=logs.append)
+    (row,) = summary["rows"]
+    assert row["verdict"] == "unexercised"
+    assert "run_s" not in row
+    assert summary["winners"] == {}
+    assert summary["baseline"]["run_s"] is None  # nothing was timed
+    assert any("UNEXERCISED" in line for line in logs), logs
+
+
+def test_probe_shapes_vary_seed_and_n_within_band():
+    """The cross-shape probe gate for gated winners covers exactly the
+    axes the entry's shape key does not pin: seed, and n inside the
+    swept scale band."""
+    mod = _load_autotune()
+    shapes = mod._probe_shapes(262_144, 3, "<=1m")
+    assert (262_144, 4) in shapes
+    assert any(n != 262_144 and s == 3 and tuning.scale_band(n) == "<=1m"
+               for n, s in shapes), shapes
 
 
 # --------------------------------------------------------------------------
