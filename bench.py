@@ -111,15 +111,31 @@ def pool_retry(fn, *args, name: str = "", retries: int = 3,
     return skip_record(last, attempts=attempt + 1)
 
 
+# The bench session this tree runs as (one per PR round): stamped into
+# every dated skip record so a BENCH_SELF_rNN.json names WHICH session
+# failed to reach hardware, and diffed against queued_since below to
+# render how many consecutive sessions each queued row has waited.
+SESSION = "r13"
+
+
+def session_number(tag: str) -> int:
+    """Numeric part of an rNN session tag ("r13" -> 13)."""
+    return int(tag.lstrip("r"))
+
+
 def skip_record(error: BaseException, attempts: int = 1) -> dict:
     """THE dated skip record (satellite: one helper instead of per-round
     hand-written JSON notes).  Every queued hardware row carries exactly
     this shape; QUEUED_HARDWARE_ROWS + queued_section() aggregate them
-    into one generated list."""
+    into one generated list.  `session` records which bench session the
+    failure happened in (the r6-r9 streak was only reconstructible by
+    diffing four BENCH_SELF files; now each record names its session and
+    the QUEUED table renders the consecutive-miss count)."""
     import datetime
 
     return {"skipped": True,
             "date": datetime.date.today().isoformat(),
+            "session": SESSION,
             "error": repr(error),
             "pool_error": is_pool_error(error),
             "attempts": attempts}
@@ -162,6 +178,11 @@ QUEUED_HARDWARE_ROWS = (
      "what": "chunk-ladder autotune sweep at 50M/100M on a v5e-8, "
              "neutrality-gated winners persisted to TUNING_TABLE.json "
              "per platform/scale band"},
+    {"row": "exchange_pipeline_50m_twins", "queued_since": "r13",
+     "capture": "capture_exchange_pipeline_twins",
+     "what": "50M S=8 -exchange-pipeline double-vs-off same-seed "
+             "wall-clock twins on a v5e-8 (the schedule is parity-pinned "
+             "bit-identical on CPU; the overlap win needs real ICI)"},
 )
 
 
@@ -171,17 +192,21 @@ def queued_section() -> str:
     --write-queued`)."""
     lines = [
         "All rows below need TPU hardware and carry dated `skipped` "
-        "records (emitted by `bench.py skip_record`) in the most recent "
-        "`BENCH_SELF_rNN.json`; the pool has been unreachable since r6. "
+        "records (emitted by `bench.py skip_record`, each stamped with "
+        "its bench session) in the most recent `BENCH_SELF_rNN.json`; "
+        "the pool has been unreachable since r6.  `missed` counts the "
+        f"consecutive sessions a row has waited as of {SESSION}. "
         "They run automatically from `python bench.py` in the next "
         "hardware window.",
         "",
-        "| queued row | since | capture | what it measures |",
-        "|---|---|---|---|",
+        "| queued row | since | missed | capture | what it measures |",
+        "|---|---|---|---|---|",
     ]
+    now = session_number(SESSION)
     for q in QUEUED_HARDWARE_ROWS:
+        missed = now - session_number(q["queued_since"]) + 1
         lines.append(f"| `{q['row']}` | {q['queued_since']} | "
-                     f"`{q['capture']}` | {q['what']} |")
+                     f"{missed} | `{q['capture']}` | {q['what']} |")
     return "\n".join(lines)
 
 
@@ -792,6 +817,27 @@ def capture_deliver_kernel_twins(detail: dict, seed: int) -> None:
             detail[f"{name}_{kern}"] = row
 
 
+def capture_exchange_pipeline_twins(detail: dict, seed: int) -> None:
+    """-exchange-pipeline A/B twins at scale (ISSUE 13): the 50M suite
+    shape on the sharded backend (S = all attached chips), run with the
+    double-buffered exchange schedule vs the serial route->drain it
+    overlaps, at the SAME n/graph/seed.  CI already pins the two gates
+    bit-identical in trajectory (tests/test_sharded.py PRE_PIPELINE_FP),
+    so these rows exist to record the measured overlap win on real ICI
+    -- the CPU fake-device mesh routes over host loopback, where the
+    collective has nothing to hide behind; an unreachable axon pool
+    leaves dated skip records that re-queue the pair."""
+    base = Config(n=50_000_000, fanout=6, graph="kout", backend="sharded",
+                  seed=seed, crashrate=0.0, coverage_target=0.99,
+                  max_rounds=3000, progress=False).validate()
+    for gate in ("off", "double"):
+        row = pool_retry(
+            _bench_backend,
+            base.replace(exchange_pipeline=gate).validate(),
+            name=f"exchange_pipeline_50m_{gate}")
+        detail[f"exchange_pipeline_50m_{gate}"] = row
+
+
 def capture_autotune(detail: dict, seed: int) -> None:
     """TPU chunk-ladder autotune sweep at the 50M and 100M bands
     (ISSUE 12): scripts/autotune.py's coordinate sweep through THIS
@@ -1086,6 +1132,9 @@ def main() -> int:
             # -deliver-kernel fused-vs-XLA wall-clock twins at 50M/100M
             # (ISSUE 9; dated skips re-queue when the pool is down).
             capture_deliver_kernel_twins(result["detail"], args.seed)
+            # 50M sharded exchange-pipeline double-vs-off twins
+            # (ISSUE 13): the overlap win needs real ICI to show.
+            capture_exchange_pipeline_twins(result["detail"], args.seed)
             # Chunk-ladder autotune sweep at the 50M/100M bands
             # (ISSUE 12): winners land in TUNING_TABLE.json.
             capture_autotune(result["detail"], args.seed)

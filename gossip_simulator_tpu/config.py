@@ -207,6 +207,19 @@ class Config:
     # on-device parity, else xla with a named reason
     # (deliver_kernel_fallback_reason).
     deliver_kernel: str = "auto"
+    # Exchange pipelining for the sharded backend (ROADMAP item 1):
+    # "double" software-pipelines the per-chunk all_to_all at chunk
+    # granularity -- the ring_append drain of batch j is deferred one
+    # batch behind the route, so XLA's async collective scheduler can
+    # hoist batch j+1's all_to_all dispatch above batch j's drain.
+    # Trajectory-preserving by construction (the dup verdict is still
+    # computed at the serial program point; only the append is staged,
+    # and in-window appends always target later windows), so "double"
+    # is bit-identical to "off".  "off" runs the serial route->drain
+    # chunk loop and reproduces pre-pipeline trajectories bit-for-bit;
+    # "auto" picks double on multi-device meshes and off elsewhere
+    # (S=1 skips the collective entirely, nothing to overlap).
+    exchange_pipeline: str = "auto"
     # Emit a TensorBoard trace of the epidemic phase.
     profile: bool = False
     profile_dir: str = "/tmp/gossip-trace"
@@ -543,6 +556,23 @@ class Config:
         return pallas_deliver.tpu_unsupported()
 
     @property
+    def exchange_pipeline_resolved(self) -> str:
+        """"off" or "double" -- resolved LAZILY (first model-build time,
+        after jaxsetup.setup(); validate() must not import jax).
+        Explicit off/double pass through; "auto" picks double only on a
+        multi-device mesh -- at S=1 the exchange is an identity (no
+        collective in the program), so there is nothing to overlap and
+        the serial loop is already optimal.  The engines additionally
+        run serial at S=1 even under a forced "double" (trivially
+        identical: the pipelined loop with no collective is the serial
+        loop plus a no-op staging buffer)."""
+        if self.exchange_pipeline in ("off", "double"):
+            return self.exchange_pipeline
+        import jax
+
+        return "double" if len(jax.devices()) > 1 else "off"
+
+    @property
     def tuning_entry_resolved(self) -> str:
         """Active tuning-table entry id(s, "+"-joined when several
         spaces match), or "defaults" -- resolved LAZILY
@@ -586,6 +616,11 @@ class Config:
                 gates["deliver_kernel"] = "unavailable"
         else:
             gates["deliver_kernel"] = None
+        # Exchange pipelining only exists on the sharded backend's
+        # routed path; everywhere else there is no exchange to overlap.
+        gates["exchange_pipeline"] = (
+            self.exchange_pipeline_resolved
+            if self.backend == "sharded" else "off")
         # The active tuning-table entry ids ("defaults" when no table
         # matches): a table CAN carry trajectory-affecting values (it is
         # reviewed, committed data -- autotune itself persists only
@@ -770,6 +805,10 @@ class Config:
             raise ValueError(
                 f"deliver_kernel must be auto|xla|pallas, "
                 f"got {self.deliver_kernel!r}")
+        if self.exchange_pipeline not in ("auto", "off", "double"):
+            raise ValueError(
+                f"exchange_pipeline must be auto|off|double, "
+                f"got {self.exchange_pipeline!r}")
         if self.dup_suppress == "on" and self.crashrate_eff > 0.0:
             raise ValueError(
                 "-dup-suppress on requires an effective crash rate of 0 "
@@ -1107,6 +1146,16 @@ def _build_parser() -> argparse.ArgumentParser:
                         "prior trajectories bit-for-bit; auto = pallas "
                         "only when the TPU capability probe passes, else "
                         "xla with a named reason")
+    p.add_argument("-exchange-pipeline", "--exchange-pipeline",
+                   dest="exchange_pipeline",
+                   choices=("auto", "off", "double"),
+                   default=d.exchange_pipeline,
+                   help="sharded exchange pipelining: double defers each "
+                        "chunk's drain one batch behind its all_to_all "
+                        "so the next dispatch overlaps the drain "
+                        "(bit-identical, A/B-pinned); off reproduces the "
+                        "serial route->drain loop bit-for-bit; auto = "
+                        "double on multi-device meshes, off at S=1")
     p.add_argument("-telemetry", "--telemetry", choices=("on", "off"),
                    default=d.telemetry,
                    help="device-resident per-window telemetry on fast-path "

@@ -98,6 +98,13 @@ def _run(cfg: Config, printer: ProgressPrinter,
             # pin that.
             printer.note(f"tuning: table entry {entry['id']} active "
                          f"(table {cfg.tuning_table})")
+    if cfg.backend == "sharded":
+        # Same self-describing-transcript rationale: "auto" resolves per
+        # host (device count), so the transcript records which schedule
+        # this run's exchange actually compiled (CI greps this line to
+        # confirm both gates were exercised).
+        printer.note(f"exchange-pipeline: {cfg.exchange_pipeline_resolved} "
+                     f"(requested {cfg.exchange_pipeline})")
     t_init = time.perf_counter()
     with _trace.span("init", cat="phase"):
         stepper.init()

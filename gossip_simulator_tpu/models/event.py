@@ -949,13 +949,18 @@ def narrow_tail_trips(count, scap: int, nscap: int):
     return nfull, nnarrow
 
 
-def run_narrow_tail(make_abody, carry, count, scap: int):
+def run_narrow_tail(make_abody, carry, count, scap: int, between=None):
     """Drive the batched append schedule: full scap-wide batches, then --
     when narrow_tail_cap engages -- the 1-2 narrow tail batches.  The ONE
     driver shared by the single-device and sharded steps; `make_abody`
     builds a fori body for a (width, lo_of) pair, `count` is the (traced)
     sender count -- pmax-agreed by the sharded caller so collective
-    counts stay uniform."""
+    counts stay uniform.  `between`, when given, transforms the carry
+    after the full-width loop and before the narrow tail (and is applied
+    unconditionally even when the narrow loop runs zero trips): the
+    pipelined sharded append uses it to flush the last full batch's
+    staged drain, so the homogeneous-shape pend carry never crosses into
+    the differently-shaped narrow batches."""
     nscap = narrow_tail_cap(scap)
     if nscap:
         nfull, nnarrow = narrow_tail_trips(count, scap, nscap)
@@ -963,6 +968,8 @@ def run_narrow_tail(make_abody, carry, count, scap: int):
         nfull = (count + scap - 1) // scap
     carry = jax.lax.fori_loop(
         0, nfull, make_abody(scap, lambda jb: jb * scap), carry)
+    if between is not None:
+        carry = between(carry)
     if nscap:
         full_end = nfull * scap
         carry = jax.lax.fori_loop(

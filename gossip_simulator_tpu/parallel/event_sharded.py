@@ -58,6 +58,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from gossip_simulator_tpu import scenario as _scen
+from gossip_simulator_tpu import tuning as _tuning
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import epidemic, event, graphs
 from gossip_simulator_tpu.models.event import EventState
@@ -264,6 +265,112 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
     return mail, cnt, dropped, xovf + ovf, sup_adds
 
 
+def _route_stage(cfg: Config, n_shards: int, n_local: int, xovf,
+                 dst_global, wslot, off, valid, rcap, pstage, flags=None,
+                 words=None):
+    """Pipelined twin of _route_and_append's route half (-exchange-pipeline
+    double): the same pre-exchange filter, wire pack, collective and
+    receiving-side filter -- op for op, so verdicts and sup_adds are
+    bit-identical -- but the ring-append arguments come back as the next
+    staged drain instead of being applied.  The caller flushes the
+    returned barrier-threaded PREVIOUS stage while this batch's
+    all_to_all is in flight (exchange.route_multi_pipelined's ordering
+    note).  Only the append is deferred: the duplicate verdict still
+    reads flags at the serial program point, and nothing between a
+    batch's route and its deferred append writes flags (appends are
+    flag-blind; SIR removal precedes the route), so deferring moves no
+    observable.  Callers guarantee n_shards > 1 (the S=1 direct path has
+    no collective to overlap).  Returns (xovf, sup_adds, stage_new,
+    pstage_threaded)."""
+    b = event.batch_ticks(cfg)
+    dw = event.ring_windows(cfg)
+    sup_adds = jnp.zeros((dw,), I32)
+    if flags is not None and PRE_EXCHANGE_SUPPRESS:
+        shard = jax.lax.axis_index(AXIS)
+        local = valid & (dst_global // n_local == shard)
+        dstl = dst_global % n_local
+        dup = local & ((flags.at[jnp.where(local, dstl, 0)].get()
+                        & event.RECEIVED) > 0)
+        sup_adds = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+                    & dup[:, None]).sum(axis=0, dtype=I32)
+        valid = valid & ~dup
+    dest = jnp.where(valid, dst_global // n_local, n_shards)
+    wire = jnp.where(
+        valid,
+        (dst_global % n_local) * (dw * b) + wslot * b + off, -1)
+    if words is not None:
+        payloads = (wire,) + tuple(
+            jax.lax.bitcast_convert_type(words[:, i], I32)
+            for i in range(words.shape[1]))
+    else:
+        payloads = (wire,)
+    recvs, ovf, pstage = exchange.route_multi_pipelined(
+        payloads, dest, valid, n_shards, rcap, pstage)
+    recv = recvs[0]
+    rvalid = recv >= 0
+    r = jnp.maximum(recv, 0)
+    rdstl = r // (dw * b)
+    rw = (r // b) % dw
+    roff = r % b
+    if flags is not None:
+        dup = rvalid & ((flags.at[rdstl].get() & event.RECEIVED) > 0)
+        sup_adds = sup_adds + (
+            (rw[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+            & dup[:, None]).sum(axis=0, dtype=I32)
+        rvalid = rvalid & ~dup
+    stage = (rdstl * b + roff, rw, rvalid)
+    if words is not None:
+        rwords = jnp.stack(
+            [jax.lax.bitcast_convert_type(c, jnp.uint32)
+             for c in recvs[1:]], axis=1)
+        rwords = jnp.where(rvalid[:, None], rwords, jnp.uint32(0))
+        stage = stage + (rwords,)
+    return xovf + ovf, sup_adds, stage, pstage
+
+
+def _flush_stage(cfg: Config, n_local: int, mail, cnt, dropped, stage,
+                 sir=False, mail_words=None):
+    """Apply a staged drain: the deferred ring_append of a batch's routed
+    arrivals, then (SIR) the batch's local re-broadcast triggers -- the
+    exact serial order _route_and_append + _append_local_triggers
+    produce, one batch late.  Appends execute in the same FIFO order as
+    the serial loop (stage j-1 always flushes before stage j), so ring
+    layout, cnt trajectory and drop counts are bit-identical.  Returns
+    (mail, cnt, dropped[, mail_words])."""
+    payload, rw, rvalid = stage[:3]
+    i = 3
+    if mail_words is not None:
+        rwords = stage[i]
+        i += 1
+        mail, cnt, dropped, mail_words = _ring_append(
+            cfg, n_local, mail, cnt, dropped, payload, rw, rvalid,
+            words=rwords, mail_words=mail_words)
+    else:
+        mail, cnt, dropped = _ring_append(
+            cfg, n_local, mail, cnt, dropped, payload, rw, rvalid)
+    if sir:
+        rows, keep, wslot, off = stage[i:i + 4]
+        mail, cnt, dropped = _append_local_triggers(
+            cfg, n_local, mail, cnt, dropped, rows, keep, wslot, off)
+    return (mail, cnt, dropped, mail_words) if mail_words is not None \
+        else (mail, cnt, dropped)
+
+
+def _empty_stage(n_lanes: int, trig_lanes: int = 0, words_w: int = 0):
+    """An all-invalid staged drain (every valid lane False): flushing it
+    reserves nothing and leaves the ring untouched, so it seeds the
+    pipeline's prologue -- the one extra no-op append a pipelined loop
+    pays per window/segment."""
+    z = jnp.zeros((n_lanes,), I32)
+    stage = (z, z, jnp.zeros((n_lanes,), bool))
+    if words_w:
+        stage = stage + (jnp.zeros((n_lanes, words_w), jnp.uint32),)
+    if trig_lanes:
+        zt = jnp.zeros((trig_lanes,), I32)
+        stage = stage + (zt, jnp.zeros((trig_lanes,), bool), zt, zt)
+    return stage
+
+
 def _append_local_triggers(cfg: Config, n_local: int, mail, cnt, dropped,
                            rows, strig, wslot, off):
     """Append SIR re-broadcast triggers (tagged self-messages,
@@ -315,6 +422,19 @@ def make_sharded_event_step(cfg: Config, mesh):
     def wire_cap(m_edges: int) -> int:
         return exchange.chernoff_cap(m_edges, s) if uniform_dest else m_edges
 
+    # Exchange pipelining (-exchange-pipeline, ROADMAP item 1): defer
+    # each batch's ring-append drain one batch behind its all_to_all so
+    # the next dispatch overlaps the drain (_route_stage/_flush_stage).
+    pipe = exchange.pipeline_enabled(cfg, s)
+    if pipe and scap:
+        # Per-buffer staged-batch width cap on the EMISSION batches only
+        # (contract-neutral: batch-boundary placement cannot change the
+        # trajectory in the zero-overflow regime -- narrow_tail_cap's
+        # envelope).  The drain chunk ccap is untouched: its width CAN
+        # move the trajectory (event.drain_chunk_floor's gated note).
+        pc = _tuning.value("exchange.pipeline_chunk", cfg)
+        if pc:
+            scap = min(scap, int(pc))
     scen = cfg.scenario_resolved
     faults = cfg.faults_enabled
     track_crashed = faults or scen.has_faults
@@ -391,7 +511,7 @@ def make_sharded_event_step(cfg: Config, mesh):
         cap = cap0
 
         def emit(flags, mail, cnt, dropped, xovf, sids, svalid, sticks,
-                 width, ecap, sw=None, mwords=None):
+                 width, ecap, sw=None, mwords=None, pstage=None):
             """Route one batch of senders' broadcasts (delay/drop draws,
             SIR removal + local triggers, all_to_all + ring append) at a
             static `width`.  Keys are shard-folded + (tick, local-row)
@@ -399,7 +519,11 @@ def make_sharded_event_step(cfg: Config, mesh):
             Returns a trailing partition-block count (Python 0 without
             partitions); under multi (`sw` = per-sender delta words
             (width, W), `mwords` = word ring) a further trailing value
-            returns the updated word ring."""
+            returns the updated word ring.  `pstage` non-None runs the
+            PIPELINED schedule (_route_stage/_flush_stage): this batch's
+            append is returned as one more trailing value (the new
+            stage) and the previous batch's stage is flushed behind this
+            batch's in-flight all_to_all instead."""
             if s == 1 and DIRECT_SELF_APPEND and not sir:
                 # One-device SI mesh: the emission IS the single-device
                 # append -- append_messages draws the identical
@@ -477,6 +601,19 @@ def make_sharded_event_step(cfg: Config, mesh):
                 ewords = jnp.broadcast_to(
                     sw[:, None, :], (width, kwidth, sw.shape[1])
                 ).reshape(-1, sw.shape[1])
+                if pstage is not None:
+                    xovf, nsup, nstage, pthr = _route_stage(
+                        cfg, s, n_local, xovf, dstg,
+                        jnp.broadcast_to(wslot2[:, None],
+                                         (width, kwidth)).reshape(-1),
+                        jnp.broadcast_to(off2[:, None],
+                                         (width, kwidth)).reshape(-1),
+                        edge.reshape(-1), ecap, pstage, words=ewords)
+                    mail, cnt, dropped, mwords = _flush_stage(
+                        cfg, n_local, mail, cnt, dropped, pthr,
+                        mail_words=mwords)
+                    return (flags, mail, cnt, dropped, xovf, nsup, blk,
+                            mwords, nstage)
                 mail, cnt, dropped, xovf, nsup, mwords = _route_and_append(
                     cfg, s, n_local, mail, cnt, dropped, xovf, dstg,
                     jnp.broadcast_to(wslot2[:, None],
@@ -487,6 +624,22 @@ def make_sharded_event_step(cfg: Config, mesh):
                     mail_words=mwords)
                 return (flags, mail, cnt, dropped, xovf, nsup, blk,
                         mwords)
+            if pstage is not None:
+                xovf, nsup, nstage, pthr = _route_stage(
+                    cfg, s, n_local, xovf, dstg,
+                    jnp.broadcast_to(wslot2[:, None],
+                                     (width, kwidth)).reshape(-1),
+                    jnp.broadcast_to(off2[:, None],
+                                     (width, kwidth)).reshape(-1),
+                    edge.reshape(-1), ecap,
+                    pstage, flags=flags if suppress else None)
+                if sir:
+                    # The batch's triggers defer WITH its data so the
+                    # flush replays the serial append order exactly.
+                    nstage = nstage + (rows, svalid & ~rem, wslot2, off2)
+                mail, cnt, dropped = _flush_stage(
+                    cfg, n_local, mail, cnt, dropped, pthr, sir=sir)
+                return flags, mail, cnt, dropped, xovf, nsup, blk, nstage
             mail, cnt, dropped, xovf, nsup = _route_and_append(
                 cfg, s, n_local, mail, cnt, dropped, xovf, dstg,
                 jnp.broadcast_to(wslot2[:, None],
@@ -521,12 +674,25 @@ def make_sharded_event_step(cfg: Config, mesh):
                 part, i = c[i], i + 1
             return core, down, part, c[i:]
 
+        # Dense-path pipelining threads the staged drain through the
+        # WHOLE chunk fori (one emit per chunk, homogeneous shapes):
+        # chunk j's drain flushes behind chunk j+1's in-flight
+        # collective, the final stage flushes after the loop.  The
+        # compacted path pipelines inside each chunk's full-width batch
+        # loop instead (make_abody/run_narrow_tail below).
+        pipe_dense = pipe and not scap
+
         def body(j, carry):
             (flags, mail, cnt, sup, dm, dr, dc, dropped,
              xovf), down, part, mt = unpack(carry)
             mail_words = rumor_words = rrecv = delta_w = None
+            pend = None
             if multi:
-                mail_words, rumor_words, rrecv = mt
+                mail_words, rumor_words, rrecv = mt[:3]
+                if pipe_dense:
+                    pend = mt[3]
+            elif pipe_dense:
+                pend = mt[0]
             off0 = j * ccap
             entry_pos = off0 + jnp.arange(ccap, dtype=I32)
             evalid = entry_pos < m
@@ -571,8 +737,17 @@ def make_sharded_event_step(cfg: Config, mesh):
                 def make_abody(width, lo_of):
                     # width * kwidth: zero-loss per-pair receive buffer
                     # at this batch width (see the step-level comment).
+                    # Only the homogeneous full-width batches pipeline
+                    # (the staged carry must keep one shape across the
+                    # fori); the 1-2 narrow tail batches stay serial --
+                    # run_narrow_tail's `between` hook flushes the last
+                    # full batch's stage before they run, so FIFO append
+                    # order is preserved.
+                    stagewise = pipe and width == scap
+
                     def abody(jb, acarry):
                         acarry = list(acarry)
+                        apend = acarry.pop() if stagewise else None
                         awords = acarry.pop() if multi else None
                         if track_part:
                             (aflags, amail, acnt, asup, adropped, axovf,
@@ -585,27 +760,46 @@ def make_sharded_event_step(cfg: Config, mesh):
                             bids, btoff, bvalid, bufw = event.sender_batch(
                                 senders, srank, scnt, spacked, b, width,
                                 jb, lo=lo_of(jb), sdelta=delta_w)
-                            (aflags, amail, acnt, adropped, axovf, sa,
-                             ablk, awords) = emit(
-                                aflags, amail, acnt, adropped, axovf,
-                                bids, bvalid, w * b + btoff, width,
-                                wire_cap(width * kwidth), sw=bufw,
-                                mwords=awords)
+                            if stagewise:
+                                (aflags, amail, acnt, adropped, axovf, sa,
+                                 ablk, awords, apend) = emit(
+                                    aflags, amail, acnt, adropped, axovf,
+                                    bids, bvalid, w * b + btoff, width,
+                                    wire_cap(width * kwidth), sw=bufw,
+                                    mwords=awords, pstage=apend)
+                            else:
+                                (aflags, amail, acnt, adropped, axovf, sa,
+                                 ablk, awords) = emit(
+                                    aflags, amail, acnt, adropped, axovf,
+                                    bids, bvalid, w * b + btoff, width,
+                                    wire_cap(width * kwidth), sw=bufw,
+                                    mwords=awords)
                         else:
                             bids, btoff, bvalid = event.sender_batch(
                                 senders, srank, scnt, spacked, b, width,
                                 jb, lo=lo_of(jb))
-                            (aflags, amail, acnt, adropped, axovf, sa,
-                             ablk) = emit(aflags, amail, acnt, adropped,
-                                          axovf, bids, bvalid,
-                                          w * b + btoff, width,
-                                          wire_cap(width * kwidth))
+                            if stagewise:
+                                (aflags, amail, acnt, adropped, axovf, sa,
+                                 ablk, apend) = emit(
+                                    aflags, amail, acnt, adropped, axovf,
+                                    bids, bvalid, w * b + btoff, width,
+                                    wire_cap(width * kwidth),
+                                    pstage=apend)
+                            else:
+                                (aflags, amail, acnt, adropped, axovf, sa,
+                                 ablk) = emit(aflags, amail, acnt,
+                                              adropped, axovf, bids,
+                                              bvalid, w * b + btoff,
+                                              width,
+                                              wire_cap(width * kwidth))
                         out = (aflags, amail, acnt, asup + sa[None, :],
                                adropped, axovf)
                         if track_part:
                             out = out + (apart + ablk,)
                         if multi:
                             out = out + (awords,)
+                        if stagewise:
+                            out = out + (apend,)
                         return out
                     return abody
 
@@ -617,8 +811,33 @@ def make_sharded_event_step(cfg: Config, mesh):
                     acarry0 = acarry0 + (part,)
                 if multi:
                     acarry0 = acarry0 + (mail_words,)
+                between = None
+                if pipe:
+                    acarry0 = acarry0 + (_empty_stage(
+                        s * wire_cap(scap * kwidth),
+                        trig_lanes=0 if multi else (scap if sir else 0),
+                        words_w=(mail_words.shape[1] if multi else 0)),)
+
+                    def between(c):
+                        # Flush the last full-width batch's stage and
+                        # strip it from the carry before the (serial,
+                        # differently-shaped) narrow tail runs.
+                        c = list(c)
+                        apend = c.pop()
+                        mw = c.pop() if multi else None
+                        if multi:
+                            c[1], c[2], c[4], mw = _flush_stage(
+                                cfg, n_local, c[1], c[2], c[4], apend,
+                                mail_words=mw)
+                            c.append(mw)
+                        else:
+                            c[1], c[2], c[4] = _flush_stage(
+                                cfg, n_local, c[1], c[2], c[4], apend,
+                                sir=sir)
+                        return tuple(c)
+
                 out = event.run_narrow_tail(make_abody, acarry0, smax,
-                                            scap)
+                                            scap, between=between)
                 (flags, mail, cnt, sup, dropped, xovf) = out[:6]
                 if multi:
                     mail_words = out[-1]
@@ -626,11 +845,22 @@ def make_sharded_event_step(cfg: Config, mesh):
                     part = out[6]
             else:
                 if multi:
-                    (flags, mail, cnt, dropped, xovf, sa, blk,
-                     mail_words) = emit(
+                    if pipe_dense:
+                        (flags, mail, cnt, dropped, xovf, sa, blk,
+                         mail_words, pend) = emit(
+                            flags, mail, cnt, dropped, xovf, ids_s,
+                            senders, w * b + toff_s, ccap, rcap,
+                            sw=delta_w, mwords=mail_words, pstage=pend)
+                    else:
+                        (flags, mail, cnt, dropped, xovf, sa, blk,
+                         mail_words) = emit(
+                            flags, mail, cnt, dropped, xovf, ids_s,
+                            senders, w * b + toff_s, ccap, rcap,
+                            sw=delta_w, mwords=mail_words)
+                elif pipe_dense:
+                    flags, mail, cnt, dropped, xovf, sa, blk, pend = emit(
                         flags, mail, cnt, dropped, xovf, ids_s, senders,
-                        w * b + toff_s, ccap, rcap, sw=delta_w,
-                        mwords=mail_words)
+                        w * b + toff_s, ccap, rcap, pstage=pend)
                 else:
                     flags, mail, cnt, dropped, xovf, sa, blk = emit(
                         flags, mail, cnt, dropped, xovf, ids_s, senders,
@@ -639,6 +869,8 @@ def make_sharded_event_step(cfg: Config, mesh):
                 if track_part:
                     part = part + blk
             mt_out = (mail_words, rumor_words, rrecv) if multi else ()
+            if pipe_dense:
+                mt_out = mt_out + (pend,)
             return pack((flags, mail, cnt, sup, dm, dr, dc, dropped,
                          xovf), down, part, mt_out)
 
@@ -650,12 +882,31 @@ def make_sharded_event_step(cfg: Config, mesh):
         # drops so they reach the per-window psum below.
         mt0 = ((st.mail_words, st.rumor_words,
                 jnp.zeros_like(st.rumor_recv)) if multi else ())
+        if pipe_dense:
+            # Prologue: the pipeline starts with an all-invalid stage
+            # (chunk 0 flushes a no-op), and the last chunk's stage
+            # flushes in the epilogue below -- before the drained slot's
+            # counters reset (the appends target later windows anyway).
+            mt0 = mt0 + (_empty_stage(
+                s * rcap,
+                trig_lanes=0 if multi else (ccap if sir else 0),
+                words_w=(st.mail_words.shape[1] if multi else 0)),)
         out = jax.lax.fori_loop(
             0, chunks, body,
             pack((st.flags, mail0, st.mail_cnt, st.sup_cnt,
                   dm0, z, z, inj_drop, z), st.down_since, z, mt0))
         (flags, mail, cnt, sup, dm, dr, dc, ddrop,
          dxovf), down, part, mt = unpack(out)
+        if pipe_dense:
+            if multi:
+                mw, rwd, rrc = mt[:3]
+                mail, cnt, ddrop, mw = _flush_stage(
+                    cfg, n_local, mail, cnt, ddrop, mt[3], mail_words=mw)
+                mt = (mw, rwd, rrc)
+            else:
+                mail, cnt, ddrop = _flush_stage(
+                    cfg, n_local, mail, cnt, ddrop, mt[0], sir=sir)
+                mt = ()
         cnt = cnt.at[0, slot].set(0)
         sup = sup.at[0, slot].set(0)
         dm, dr, dc, ddrop, dxovf = jax.lax.psum((dm, dr, dc, ddrop, dxovf),
@@ -946,6 +1197,7 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
         from gossip_simulator_tpu.utils import telemetry as telem
 
         sir = cfg.protocol == "sir"
+        ihwm = exchange.inflight_hwm(cfg, mesh.shape[AXIS])
         hspecs = telem.History(idx=P(), cols=P(None, None))
 
         @functools.partial(jax.jit, donate_argnums=(0, 4))
@@ -961,7 +1213,8 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
                     row = telem.gossip_probe(
                         s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
                         pmax=lambda x: jax.lax.pmax(x, AXIS),
-                        rumors=rumors if multi else 0)
+                        rumors=rumors if multi else 0,
+                        inflight_hwm=ihwm)
                     return s, telem.record(h, row)
 
                 return jax.lax.while_loop(cond, body, (st, hist))

@@ -71,6 +71,26 @@ def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
             slot-aligned across payloads.
         overflow: int32[] messages dropped for capacity locally.
     """
+    stacked, overflow = _bucket_pack(payloads, dest_shard, valid, n_shards,
+                                     cap, sort_buckets)
+    if n_shards > 1:
+        recv = jax.lax.all_to_all(stacked, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    else:
+        # A tiled 1-device all_to_all is the identity; skip the collective
+        # (every S=1 route caller -- the routing-constant bench twins, the
+        # ring engine's deliveries, the overlay -- pays it per batch).
+        recv = stacked
+    recvs = tuple(recv[:, i * cap:(i + 1) * cap].reshape(-1)
+                  for i in range(len(payloads)))
+    return recvs, overflow
+
+
+def _bucket_pack(payloads, dest_shard, valid, n_shards, cap, sort_buckets):
+    """Bucket-by-destination rank + flat scatter into the [S, len(payloads)
+    * cap] send buffer -- the pre-collective half of route_multi, split out
+    so the pipelined route can order the pack against the previous batch's
+    staged drain.  Op-for-op the round-6 pack (bit-identical buffers)."""
     if sort_buckets is None:
         sort_buckets = n_shards > _tuning.value(
             "exchange.rank_max_shards", None, default=RANK_MAX_SHARDS)
@@ -106,18 +126,66 @@ def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
         buf = jnp.full((n_shards * cap + 1,), -1, I32)
         bufs.append(buf.at[flat].set(v)
                     [:n_shards * cap].reshape(n_shards, cap))
-    stacked = jnp.concatenate(bufs, axis=1)
+    return jnp.concatenate(bufs, axis=1), overflow
+
+
+def route_multi_pipelined(payloads, dest_shard: jnp.ndarray,
+                          valid: jnp.ndarray, n_shards: int, cap: int,
+                          stage, axis: str = AXIS,
+                          sort_buckets: bool | None = None):
+    """Double-buffered route_multi: pack this batch's send buffer, ORDER
+    the pack before the previous batch's staged drain with
+    `lax.optimization_barrier`, then dispatch the collective.
+
+    `stage` is the caller's pending-drain carry (any pytree; in
+    event_sharded it is the deferred ring_append arguments from the
+    previous batch).  The barrier makes every returned stage leaf depend
+    on the packed send buffer, so the drain that consumes the returned
+    `stage` cannot be scheduled before the pack materializes -- at which
+    point the all_to_all's start has no remaining inputs, and XLA's
+    async collective scheduler (which splits the op into start/done) is
+    free to hoist the dispatch above the whole drain.  The values are
+    untouched (optimization_barrier is an identity), so delivered bits
+    are exactly route_multi's.
+
+    Returns (recvs, overflow, stage) -- recvs/overflow as route_multi,
+    stage the barrier-threaded carry to drain now.
+    """
+    stacked, overflow = _bucket_pack(payloads, dest_shard, valid, n_shards,
+                                     cap, sort_buckets)
+    leaves, treedef = jax.tree_util.tree_flatten(stage)
+    if leaves:
+        stacked, *leaves = jax.lax.optimization_barrier((stacked, *leaves))
+        stage = jax.tree_util.tree_unflatten(treedef, leaves)
     if n_shards > 1:
         recv = jax.lax.all_to_all(stacked, axis, split_axis=0,
                                   concat_axis=0, tiled=True)
     else:
-        # A tiled 1-device all_to_all is the identity; skip the collective
-        # (every S=1 route caller -- the routing-constant bench twins, the
-        # ring engine's deliveries, the overlay -- pays it per batch).
         recv = stacked
     recvs = tuple(recv[:, i * cap:(i + 1) * cap].reshape(-1)
-                  for i in range(len(bufs)))
-    return recvs, overflow
+                  for i in range(len(payloads)))
+    return recvs, overflow, stage
+
+
+def pipeline_enabled(cfg, n_shards: int) -> bool:
+    """Whether the routed exchange runs the double-buffered schedule
+    (-exchange-pipeline, ROADMAP item 1) on an `n_shards` mesh -- the ONE
+    gate every sharded engine consults.  S=1 always runs serial: there is
+    no collective in the program to overlap, so a forced "double" is
+    trivially identical there.  exchange.pipeline_depth < 2 (tuning)
+    also falls back to serial -- depth 1 IS the serial schedule."""
+    return (n_shards > 1 and cfg.exchange_pipeline_resolved == "double"
+            and _tuning.value("exchange.pipeline_depth", cfg) >= 2)
+
+
+def inflight_hwm(cfg, n_shards: int) -> int:
+    """Static high-water mark of exchange buffers alive at once on an
+    engine build (the telemetry `exchange_inflight_hwm` column): 0 = no
+    collective in the program (S=1 routes are the identity), 1 = serial
+    route->drain, 2 = the double-buffered pipeline."""
+    if n_shards <= 1:
+        return 0
+    return 2 if pipeline_enabled(cfg, n_shards) else 1
 
 
 def route_one(payload: jnp.ndarray, dest_shard: jnp.ndarray,

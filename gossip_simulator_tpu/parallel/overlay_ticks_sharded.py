@@ -72,6 +72,39 @@ def _route_append(cfg, n_local, s, ring, dst_g, pay, wslot, valid, rcap):
     return ring_dst, ring_pay, ring_cnt, dropped
 
 
+def _route_stage_ot(cfg, n_local, s, dst_g, pay, wslot, valid, rcap,
+                    pstage):
+    """Pipelined twin of _route_append's route half (-exchange-pipeline
+    double): same pack/route, append deferred -- the caller appends the
+    barrier-threaded PREVIOUS stage behind this chunk's in-flight
+    collective.  Nothing in the emission chunk loop reads the ring
+    (first_true_indices keys off `remaining` only), so the deferral is
+    bit-identical; stage = ((rd, rp, rw), ovf) with -1 the empty-lane
+    sentinel."""
+    (rd, rp, rw), ovf, pstage = exchange.route_multi_pipelined(
+        (jnp.where(valid, dst_g % n_local, -1),
+         jnp.where(valid, pay, -1),
+         jnp.where(valid, wslot, -1)),
+        jnp.where(valid, dst_g // n_local, s), valid, s, rcap, pstage)
+    return (rd, rp, rw), ovf, pstage
+
+
+def _flush_append_ot(cfg, ring, stage, ovf):
+    """Apply a staged append (the deferred half of _route_stage_ot) --
+    the exact ring_append _route_append runs, one chunk late."""
+    ring_dst, ring_pay, ring_cnt, dropped = ring
+    dw = ot.ring_windows(cfg)
+    cap = (ring_dst.shape[0] - 1) // dw
+    rd, rp, rw = stage
+    rvalid = rd >= 0
+    (ring_dst, ring_pay), ring_cnt, dropped = ring_append(
+        (ring_dst, ring_pay), ring_cnt, dropped + ovf,
+        (jnp.where(rvalid, rd, 0), jnp.where(rvalid, rp, 0)),
+        jnp.where(rvalid, rw, 0), rvalid, dw, cap,
+        kernel=cfg.deliver_kernel_resolved)
+    return ring_dst, ring_pay, ring_cnt, dropped
+
+
 def make_sharded_init(cfg: Config, mesh):
     """Per-shard state + the routed window-0 bootstrap burst."""
     n, f, k = cfg.n, cfg.fanout, cfg.max_degree
@@ -148,6 +181,11 @@ def make_poll_fn(cfg: Config, mesh):
     echunk = ot.emit_chunk(cfg, n_local)
     rcap = exchange.epidemic_cap(echunk, 1, s)
     steps = max(1, -(-10 // b))
+    # Exchange pipelining: emit_routed's chunk loop defers each chunk's
+    # ring append one chunk behind its all_to_all, contained inside the
+    # emission (prologue seeds an empty stage, epilogue flushes the last
+    # one before the step sequencing continues).
+    pipe = exchange.pipeline_enabled(cfg, s)
 
     def emit_routed(ring, base_key, w, em_dst, em_toff, typ, op):
         """Compact a local (n_local, cap_mb) emission buffer, draw
@@ -162,8 +200,7 @@ def make_poll_fn(cfg: Config, mesh):
         total = jax.lax.pmax(valid_all.sum(dtype=I32), AXIS)
         kd = _rng.tick_key(base_key, w, op)
 
-        def body(_, carry):
-            ring, remaining = carry
+        def chunk_args(remaining):
             idx = first_true_indices(remaining, echunk)
             hit = jnp.zeros((flat_n,), bool).at[idx].set(True, mode="drop")
             remaining = remaining & ~hit
@@ -176,14 +213,34 @@ def make_poll_fn(cfg: Config, mesh):
                 kd, cfg.delaylow, cfg.delayhigh,
                 jnp.where(okx, shard * flat_n + idx, s * flat_n))
             arrive = w * b + toff + delay
+            return (remaining, jnp.where(valid, dst, 0),
+                    (src_g * 2 + typ) * b + arrive % b,
+                    (arrive // b) % dw, valid)
+
+        nchunks = (total + echunk - 1) // echunk
+        if pipe:
+            def body_pipe(_, carry):
+                ring, remaining, (pstage, povf) = carry
+                remaining, dstv, pay, wsl, valid = chunk_args(remaining)
+                nstage, ovf, pthr = _route_stage_ot(
+                    cfg, n_local, s, dstv, pay, wsl, valid, rcap, pstage)
+                ring = _flush_append_ot(cfg, ring, pthr, povf)
+                return ring, remaining, (nstage, ovf)
+
+            empty = ((jnp.full((s * rcap,), -1, I32),) * 3,
+                     jnp.zeros((), I32))
+            ring, _, (pend, povf) = jax.lax.fori_loop(
+                0, nchunks, body_pipe, (ring, valid_all, empty))
+            return _flush_append_ot(cfg, ring, pend, povf)
+
+        def body(_, carry):
+            ring, remaining = carry
+            remaining, dstv, pay, wsl, valid = chunk_args(remaining)
             ring = _route_append(
-                cfg, n_local, s, ring, jnp.where(valid, dst, 0),
-                (src_g * 2 + typ) * b + arrive % b,
-                (arrive // b) % dw, valid, rcap)
+                cfg, n_local, s, ring, dstv, pay, wsl, valid, rcap)
             return ring, remaining
 
-        (ring, _) = jax.lax.fori_loop(
-            0, (total + echunk - 1) // echunk, body, (ring, valid_all))
+        (ring, _) = jax.lax.fori_loop(0, nchunks, body, (ring, valid_all))
         return ring
 
     def ids_fn():

@@ -99,12 +99,53 @@ def _deposit_routed(cfg: Config, n_local: int, n_shards: int, pending,
     return pending, overflow
 
 
+def _route_stage_si(cfg: Config, n_local: int, n_shards: int, dst_global,
+                    slots, valid, cap: int, pstage):
+    """Pipelined twin of _deposit_routed's route half (-exchange-pipeline
+    double): the same pack/route/unpack, but the deposit arguments come
+    back as the next staged drain instead of being scattered -- the
+    caller deposits the barrier-threaded PREVIOUS stage while this
+    chunk's all_to_all is in flight.  Deferring the deposit is
+    trivially bit-identical here: nothing in the chunk loop reads
+    `pending` (compact_gather keys off friends/dslot/remaining only),
+    and deposits replay in the serial FIFO order.  Returns
+    (stage_new, overflow, pstage_threaded)."""
+    d = epidemic.ring_depth(cfg)
+    dest_shard = jnp.where(valid, dst_global // n_local, n_shards)
+    dst_local = jnp.where(valid, dst_global % n_local, 0)
+    packed = jnp.where(valid, exchange.pack_dst_slot(dst_local, slots, d), -1)
+    (recv,), overflow, pstage = exchange.route_multi_pipelined(
+        (packed,), dest_shard, valid, n_shards, cap, pstage)
+    rvalid = recv >= 0
+    rdst, rslot = exchange.unpack_dst_slot(jnp.maximum(recv, 0), d)
+    return (rdst, rslot, rvalid), overflow, pstage
+
+
+def _flush_deposit(cfg: Config, pending, stage):
+    """Apply a staged deposit (the deferred half of _route_stage_si)."""
+    rdst, rslot, rvalid = stage
+    return epidemic.deposit_local(pending, rdst, rslot, rvalid,
+                                  kernel=cfg.deliver_kernel_resolved)
+
+
+def _empty_deposit_stage(n_lanes: int):
+    """All-invalid staged deposit: scattering it is a no-op, seeds the
+    pipeline's prologue."""
+    z = jnp.zeros((n_lanes,), I32)
+    return (z, z, jnp.zeros((n_lanes,), bool))
+
+
 def make_sharded_tick(cfg: Config, mesh):
     """Per-tick transition as a shard_map body (composable into loops)."""
     s = mesh.shape[AXIS]
     n_local = shard_size(cfg.n, mesh)
 
     track_part = cfg.scenario_resolved.has_partitions
+    # Exchange pipelining (-exchange-pipeline double): the compact chunk
+    # loop defers each chunk's pending-ring deposit one chunk behind its
+    # all_to_all (see _route_stage_si); the dense path's single route
+    # per tick has no loop to pipeline and stays serial.
+    pipe = exchange.pipeline_enabled(cfg, s)
 
     def tick_shard(st: SimState, base_key: jax.Array) -> SimState:
         shard = jax.lax.axis_index(AXIS)
@@ -139,7 +180,30 @@ def make_sharded_tick(cfg: Config, mesh):
                 rcap = min(exchange.epidemic_cap(n_local, width, s),
                            ccap * width)
 
-            if track_part:
+            if pipe:
+                # Pipelined chunk loop (-exchange-pipeline double): chunk
+                # j's deposit flushes behind chunk j+1's in-flight
+                # collective (_route_stage_si's identity note); the last
+                # stage flushes after the loop.
+                def body_pipe(_, carry):
+                    pending, remaining, ovf, blk, pend = carry
+                    (dstg, slots, valid, remaining,
+                     b2) = epidemic.compact_gather(
+                        cfg, stp.friends, stp.friend_cnt, dslot,
+                        keys["delay"], keys["drop"], st.tick, remaining,
+                        ccap, **(dict(gid0=gid0) if track_part else {}))
+                    nstage, o, pthr = _route_stage_si(
+                        cfg, n_local, s, dstg, slots, valid, rcap, pend)
+                    pending = _flush_deposit(cfg, pending, pthr)
+                    return (pending, remaining, ovf + o,
+                            blk + (b2 if track_part else 0), nstage)
+
+                pending, _, ovf, blk, pend = jax.lax.fori_loop(
+                    0, chunks, body_pipe,
+                    (stp.pending, senders, jnp.zeros((), I32), zblk,
+                     _empty_deposit_stage(s * rcap)))
+                pending = _flush_deposit(cfg, pending, pend)
+            elif track_part:
                 def body_p(_, carry):
                     pending, remaining, ovf, blk = carry
                     (dstg, slots, valid, remaining,
@@ -551,6 +615,7 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
         from gossip_simulator_tpu.utils import telemetry as telem
 
         sir = cfg.protocol == "sir"
+        ihwm = exchange.inflight_hwm(cfg, mesh.shape[AXIS])
         hspecs = telem.History(idx=P(), cols=P(None, None))
 
         @functools.partial(jax.jit, donate_argnums=(0, 4))
@@ -565,7 +630,8 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
                     s = advance(s, base_key)
                     row = telem.gossip_probe(
                         s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
-                        pmax=lambda x: jax.lax.pmax(x, AXIS))
+                        pmax=lambda x: jax.lax.pmax(x, AXIS),
+                        inflight_hwm=ihwm)
                     return s, telem.record(h, row)
 
                 return jax.lax.while_loop(cond, body, (st, hist))

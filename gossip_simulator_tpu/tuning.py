@@ -167,6 +167,22 @@ _register("exchange.chernoff_pad", "gossip_simulator_tpu.parallel.exchange",
           "PROFILE_EXCHANGE.json",
           "wire-cap pad multiplier (pad = max(64, k*sqrt(mean))); smaller "
           "raises overflow odds -- capacity, never table-persisted")
+_register("exchange.pipeline_depth",
+          "gossip_simulator_tpu.parallel.event_sharded",
+          2, (1, 2), int, "contract",
+          "PROFILE_EXCHANGE.json",
+          "staged exchange buffers under -exchange-pipeline double "
+          "(2 = drain one batch behind the all_to_all, 1 = the serial "
+          "schedule; only the append is deferred, so both are "
+          "bit-identical -- pinned by test_sharded)")
+_register("exchange.pipeline_chunk",
+          "gossip_simulator_tpu.parallel.event_sharded",
+          0, (0, 65_536, 131_072, 262_144), int, "contract",
+          "PROFILE_EXCHANGE.json",
+          "per-buffer staged emission-batch width cap under the "
+          "pipelined exchange (0 = inherit sender_compaction_cap); "
+          "batch boundaries are trajectory-free in the zero-overflow "
+          "regime (narrow_tail_cap's envelope)")
 _register("event.slot_headroom", "gossip_simulator_tpu.models.event",
           1.5, (1.25, 1.5, 2.0), float, "never",
           "BENCH_SELF_r05.json",

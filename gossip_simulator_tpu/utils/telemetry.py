@@ -40,7 +40,8 @@ import numpy as np
 # replayed stdout/JSONL surface is unchanged.
 GOSSIP_COLS = ("tick", "received", "msg_hi", "msg_lo", "crashed", "removed",
                "mail_high", "dropped", "overflow", "scen_crashed",
-               "recovered", "repaired", "part_dropped", "rumors_done")
+               "recovered", "repaired", "part_dropped", "rumors_done",
+               "exchange_inflight_hwm")
 OVERLAY_COLS = ("clock", "makeups", "breakups", "dropped")
 
 # Named column indices -- THE way to address a history column (schema v3
@@ -78,7 +79,8 @@ def record(hist: History, row) -> History:
     return History(idx=hist.idx + 1, cols=hist.cols.at[i].set(vals))
 
 
-def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0):
+def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0,
+                 inflight_hwm: int = 0):
     """One GOSSIP_COLS row from either epidemic engine's state (duck-typed
     like models/state.in_flight: EventState has the mail ring, SimState the
     pending ring).  `psum`/`pmax` are the sharded engines' cross-shard
@@ -86,7 +88,12 @@ def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0):
     the totals are already psum-replicated by the step functions.  `rumors`
     (static R; 0 = single-rumor) adds the count of rumors that have hit the
     coverage target -- rumor_done is replicated on every engine, so no
-    reduction applies."""
+    reduction applies.  `inflight_hwm` (static, per engine build) is the
+    high-water mark of exchange buffers alive at once on the sharded
+    routed path: 0 = no collective in the program (single device /
+    non-sharded), 1 = the serial route->drain loop, 2 = the
+    double-buffered pipeline (-exchange-pipeline double -- one staged
+    drain in flight behind the dispatched all_to_all)."""
     import jax
     import jax.numpy as jnp
 
@@ -113,7 +120,7 @@ def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0):
     return [st.tick, st.total_received, msg[0], msg[1], st.total_crashed,
             removed, high, dropped, st.exchange_overflow,
             st.scen_crashed, st.scen_recovered, st.heal_repaired,
-            st.part_dropped, rdone]
+            st.part_dropped, rdone, jnp.asarray(inflight_hwm, I32)]
 
 
 def overlay_probe(st):
@@ -344,6 +351,12 @@ class TelemetryReport:
                         and bool(col("rumors_done").any())):
                     # Multi-rumor column only when rumors completed.
                     per["rumors_done"] = col("rumors_done").tolist()
+                if (cols.shape[1] > GCOL["exchange_inflight_hwm"]
+                        and bool(col("exchange_inflight_hwm").any())):
+                    # Exchange-pipeline depth column only when a routed
+                    # exchange ran (single-device builds record 0).
+                    per["exchange_inflight_hwm"] = \
+                        col("exchange_inflight_hwm").tolist()
                 out["per_window"] = per
                 out["deltas"] = {
                     "received": np.diff(col("received"),

@@ -101,6 +101,15 @@ def main() -> int:
                 print(f"FAIL: header schema_version "
                       f"{head.get('schema_version')} != {SCHEMA_VERSION}")
                 ok = False
+            # The exchange-pipeline column (ISSUE 13) must be named in
+            # the header even on this single-device run -- consumers key
+            # per-window arrays off the header, so a build that dropped
+            # the column would silently shift everything after it.
+            if "exchange_inflight_hwm" not in head.get(
+                    "columns", {}).get("gossip", []):
+                print("FAIL: header gossip columns missing "
+                      "exchange_inflight_hwm")
+                ok = False
         else:
             print("FAIL: JSONL stream does not open with the v3 header")
             ok = False

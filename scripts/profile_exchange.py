@@ -16,7 +16,11 @@ constants behind the README v5e-8 projection are measured, not assumed:
     the eliminated work is the difference between the columns;
   * `wire_cap`: the S-shard route at the zero-loss per-pair cap vs
     exchange.chernoff_cap -- the payload/unpack width the high-water
-    sizing removes.
+    sizing removes;
+  * `pipeline_split` (ISSUE 13): the route term vs the drain term vs the
+    fused serial roundtrip on the S-shard mesh, plus the overlap bound
+    max(route, drain) and headroom_x = serial / bound -- the ceiling the
+    -exchange-pipeline double-buffered schedule can recover.
 
 Each row reports seconds/call and ns/lane.  Results land in one JSON
 (default PROFILE_EXCHANGE.json next to the repo's other artifacts);
@@ -173,6 +177,87 @@ def profile_fused_append(m: int, iters: int) -> dict:
     }
 
 
+def profile_pipeline_split(s: int, m: int, iters: int) -> dict:
+    """Route-vs-drain split for the pipelined exchange (ISSUE 13).
+
+    Times, on the s-shard mesh: the `route` term (wire pack + all_to_all,
+    what the double-buffered schedule keeps in flight), the `drain` term
+    (unpack + ring_append of a received buffer, what it overlaps the
+    route with), and the fused `serial` roundtrip (route then drain in
+    one program -- the -exchange-pipeline off schedule).  The pipeline's
+    steady-state per-batch floor is max(route, drain); headroom_x =
+    serial / max(route, drain) is the overlap ceiling the double-buffered
+    schedule can recover on THIS host (2.0x only when the terms balance;
+    the README design note quotes this row)."""
+    mesh = node_mesh(s)
+    cap = exchange.chernoff_cap(m, s)
+    lanes = s * cap
+    n_local = max(1024, m)
+    rcap = lanes  # one received batch fits the ring: no counted drops
+    rng = np.random.default_rng(1)
+    ring = np.zeros((s, DW * rcap + 1), np.int32)
+    cnt = np.zeros((s, 1, DW), np.int32)
+    dst = rng.integers(0, n_local, (s, m), dtype=np.int32)
+    dshard = rng.integers(0, s, (s, m), dtype=np.int32)
+    wslot = rng.integers(0, DW, (s, m), dtype=np.int32)
+    off = rng.integers(0, B, (s, m), dtype=np.int32)
+    valid = rng.random((s, m)) < 0.9
+
+    def _wire(dst, wslot, off, valid):
+        return jnp.where(valid, dst * (DW * B) + wslot * B + off, -1)
+
+    def _append(ring, cnt, recv):
+        r = jnp.maximum(recv, 0)
+        rv = recv >= 0
+        (rg,), ct, dp = ring_append(
+            (ring,), cnt, jnp.zeros((), jnp.int32),
+            ((r // (DW * B)) * B + r % B,), (r // B) % DW, rv, DW, rcap)
+        return rg, ct, dp
+
+    def _route(dst, dshard, wslot, off, valid):
+        (recv,), ovf = exchange.route_multi(
+            (_wire(dst[0], wslot[0], off[0], valid[0]),), dshard[0],
+            valid[0], s, cap)
+        return recv[None], ovf[None]
+
+    def _drain(ring, cnt, recv):
+        rg, ct, dp = _append(ring[0], cnt[0], recv[0])
+        return rg[None], ct[None], dp[None]
+
+    def _serial(ring, cnt, dst, dshard, wslot, off, valid):
+        (recv,), ovf = exchange.route_multi(
+            (_wire(dst[0], wslot[0], off[0], valid[0]),), dshard[0],
+            valid[0], s, cap)
+        rg, ct, dp = _append(ring[0], cnt[0], recv)
+        return rg[None], ct[None], (dp + ovf)[None]
+
+    route_fn = jax.jit(shard_map(_route, mesh=mesh,
+                                 in_specs=(P(AXIS, None),) * 5,
+                                 out_specs=(P(AXIS, None), P(AXIS))))
+    drain_fn = jax.jit(shard_map(_drain, mesh=mesh,
+                                 in_specs=(P(AXIS, None),) * 3,
+                                 out_specs=(P(AXIS, None),) * 2 + (P(AXIS),)))
+    serial_fn = jax.jit(shard_map(_serial, mesh=mesh,
+                                  in_specs=(P(AXIS, None),) * 7,
+                                  out_specs=(P(AXIS, None),) * 2 + (P(AXIS),)))
+
+    recv, _ = route_fn(dst, dshard, wslot, off, valid)
+    recv = np.asarray(jax.device_get(recv))
+    t_route = _timeit(route_fn, (dst, dshard, wslot, off, valid), iters)
+    t_drain = _timeit(drain_fn, (ring, cnt, recv), iters)
+    t_serial = _timeit(serial_fn, (ring, cnt, dst, dshard, wslot, off,
+                                   valid), iters)
+    bound = max(t_route, t_drain)
+    return {
+        "cap": cap,
+        "route_s": t_route, "route_ns_per_lane": t_route * 1e9 / m,
+        "drain_s": t_drain, "drain_ns_per_lane": t_drain * 1e9 / m,
+        "serial_s": t_serial, "serial_ns_per_lane": t_serial * 1e9 / m,
+        "overlap_bound_s": bound,
+        "headroom_x": t_serial / bound if bound > 0 else 1.0,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--m", type=int, default=None,
@@ -212,6 +297,10 @@ def main() -> int:
             rows[name] = {"cap": cap, "s_per_call": t,
                           "ns_per_lane": t * 1e9 / m}
         rec["rows"]["route"] = rows
+        # ISSUE 13: the route-vs-drain split + overlap headroom the
+        # -exchange-pipeline schedule is bounded by on this host.
+        rec["rows"]["pipeline_split"] = profile_pipeline_split(
+            s, m, args.iters)
 
     with open(args.out, "w") as fh:
         json.dump(rec, fh, indent=1)

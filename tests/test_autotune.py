@@ -54,6 +54,11 @@ PRE_REGISTRY_DEFAULTS = {
     "overlay_ticks.delivery_chunk_cap": 2_097_152,
     "exchange.rank_max_shards": 16,
     "exchange.chernoff_pad": 8,
+    # Pipelined exchange (ISSUE 13): depth 2 IS the serial trajectory --
+    # the schedule overlaps, the bits are pinned identical (test_sharded's
+    # off-vs-double pins); chunk 0 = inherit sender_compaction_cap.
+    "exchange.pipeline_depth": 2,
+    "exchange.pipeline_chunk": 0,
     "event.slot_headroom": 1.5,
     "event.drain_chunk_floor": 131_072,
     "event.drain_chunk_hi": 1_048_576,

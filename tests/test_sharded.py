@@ -312,3 +312,144 @@ def test_sharded_narrow_tail_same_totals(monkeypatch):
     # (sender_compaction_cap's caveat) -- pin that this run is in it.
     assert rn.stats.mailbox_dropped == 0
     assert rn.stats.exchange_overflow == 0
+
+
+# --------------------------------------------------------------------------
+# Exchange pipelining (ISSUE 13): -exchange-pipeline off must reproduce the
+# pre-pipeline build bit-for-bit, and "double" must reproduce "off" -- the
+# double-buffered schedule overlaps the all_to_all with the previous
+# batch's drain, it must never move the trajectory.
+# --------------------------------------------------------------------------
+
+PIPELINE_BASE = dict(n=4000, graph="kout", fanout=6, seed=3, crashrate=0.01,
+                     coverage_target=0.95, progress=False, backend="sharded")
+
+# Trajectory fingerprints captured on the PRE-pipeline build (PR 12 head),
+# test_multirumor convention: sha256[:16] of the per-window
+# (round, received, message, crashed, removed) rows.  `off` AND `double`
+# must both land exactly here.
+PRE_PIPELINE_FP = {
+    "event_s8": "b8c00f159feac434",
+    "ring_s8": "a7f0a9290df481e5",
+    "event_s1": "bb9126ef34fd1324",
+    "event_s8_r16": "a779b319b065da05",
+    "event_s8_xla": "b8c00f159feac434",
+    "event_s8_spill": "ca01d65e017e2508",
+    "event_s1_r16": "6e6764e2bf953d0e",
+}
+
+PIPELINE_COMBOS = {
+    "event_s8": (dict(engine="event"), None),
+    "ring_s8": (dict(engine="ring"), None),
+    "event_s1": (dict(engine="event"), 1),
+    "event_s8_r16": (dict(engine="event", rumors=16), None),
+    "event_s8_xla": (dict(engine="event", deliver_kernel="xla"), None),
+    # Slot cap 48 forces counted mail-ring spill: the deferred appends
+    # must drop the SAME messages (FIFO order preserved across the flush).
+    "event_s8_spill": (dict(engine="event", event_slot_cap=48), None),
+    "event_s1_r16": (dict(engine="event", rumors=16), 1),
+}
+
+
+def _pipeline_fp(name: str, pipeline: str):
+    import hashlib
+    import json as _json
+
+    kw, nd = PIPELINE_COMBOS[name]
+    cfg = Config(**{**PIPELINE_BASE, **kw,
+                    "exchange_pipeline": pipeline}).validate()
+    s = ShardedStepper(cfg, n_devices=nd)
+    s.init()
+    while not s.overlay_window()[2]:
+        pass
+    s.seed()
+    rows = []
+    for _ in range(400):
+        st = s.gossip_window()
+        rows.append((st.round, st.total_received, st.total_message,
+                     st.total_crashed, st.total_removed))
+        if st.coverage >= cfg.coverage_target or s.exhausted:
+            break
+    h = hashlib.sha256(_json.dumps(rows).encode()).hexdigest()[:16]
+    dropped = int(np.asarray(jax.device_get(s.state.mail_dropped)).sum()) \
+        if hasattr(s.state, "mail_dropped") else None
+    return h, dropped
+
+
+@pytest.mark.parametrize("combo", sorted(PIPELINE_COMBOS))
+def test_exchange_pipeline_gates_bit_identical(combo):
+    """off == the pre-pipeline pin, double == the same pin (hence == off),
+    on every engine combo: S=8/S=1, ring engine, R=16 word ladders, the
+    explicit xla deliver kernel, and the counted-spill corner."""
+    h_off, d_off = _pipeline_fp(combo, "off")
+    assert h_off == PRE_PIPELINE_FP[combo], \
+        f"{combo}: -exchange-pipeline off moved off the pre-pipeline build"
+    h_dbl, d_dbl = _pipeline_fp(combo, "double")
+    assert h_dbl == PRE_PIPELINE_FP[combo], \
+        f"{combo}: -exchange-pipeline double diverged from off"
+    assert d_dbl == d_off, f"{combo}: drop totals moved under the pipeline"
+    if combo == "event_s8_spill":
+        # The corner is only a corner if spill actually happened.
+        assert d_off and d_off > 0
+
+
+def test_exchange_pipeline_resume_gate_flip(tmp_path):
+    """A snapshot written under -exchange-pipeline off restores into a
+    "double" build (and vice versa) and continues the IDENTICAL
+    trajectory: the pipeline is pure schedule, the state pytree carries no
+    pipeline residue (the stage drains inside every jitted window)."""
+    from gossip_simulator_tpu.utils import checkpoint
+
+    def make(pipeline):
+        cfg = Config(**{**PIPELINE_BASE, "engine": "event",
+                        "exchange_pipeline": pipeline}).validate()
+        s = ShardedStepper(cfg)
+        s.init()
+        while not s.overlay_window()[2]:
+            pass
+        s.seed()
+        return s
+
+    s = make("off")
+    s.gossip_window()
+    s.gossip_window()
+    mid = s.stats()
+    path = checkpoint.save(str(tmp_path), 2, s.state_pytree(), mid)
+    reference = [s.gossip_window() for _ in range(6)]
+
+    s2 = make("double")
+    tree, _ = checkpoint.load(path)
+    s2.load_state_pytree(tree)
+    assert s2.stats() == mid
+    for want in reference:
+        assert s2.gossip_window() == want
+
+
+@pytest.mark.parametrize("engine", ["event", "ring"])
+def test_exchange_pipeline_sir_gates_identical(engine):
+    """SIR exercises the one piece of staged state the SI pins can't: the
+    deferred local re-broadcast TRIGGERS (event engine) ride the stage
+    with their batch's data, and removal flags written between a route
+    and its deferred append must not move the verdicts (the removal
+    precedes the route at the serial program point).  Runtime A/B -- no
+    pre-captured hash, the two gates must simply agree window-for-window."""
+    def traj(pipeline):
+        cfg = Config(n=4000, graph="kout", fanout=8, seed=3, crashrate=0.01,
+                     protocol="sir", removal_rate=0.25, engine=engine,
+                     coverage_target=0.9, progress=False, backend="sharded",
+                     exchange_pipeline=pipeline).validate()
+        s = ShardedStepper(cfg)
+        s.init()
+        while not s.overlay_window()[2]:
+            pass
+        s.seed()
+        rows = []
+        for _ in range(200):
+            st = s.gossip_window()
+            rows.append((st.round, st.total_received, st.total_message,
+                         st.total_crashed, st.total_removed))
+            if st.coverage >= cfg.coverage_target or s.exhausted:
+                break
+        return rows
+
+    assert traj("off") == traj("double")

@@ -111,6 +111,25 @@ def test_telemetry_per_window_consistency(tmp_path):
     assert len(cov) == res.gossip_windows
 
 
+def test_exchange_inflight_hwm_column(tmp_path):
+    """The ISSUE-13 pipeline-depth column: a sharded run on the 8-device
+    shim (auto -> double) records 2 in every window, a forced-serial run
+    records 1, and single-device builds record 0 -- an all-zero column
+    the summary omits (like the scenario columns)."""
+    _, recs, _ = _capture(tmp_path, "xp2", **VARIANTS["si_event_sharded"])
+    t = [r for r in recs if r["event"] == "telemetry"][0]
+    assert (t["per_window"]["exchange_inflight_hwm"]
+            == [2] * t["gossip_windows"])
+    _, recs1, _ = _capture(tmp_path, "xp1", exchange_pipeline="off",
+                           **VARIANTS["si_event_sharded"])
+    t1 = [r for r in recs1 if r["event"] == "telemetry"][0]
+    assert (t1["per_window"]["exchange_inflight_hwm"]
+            == [1] * t1["gossip_windows"])
+    _, recs0, _ = _capture(tmp_path, "xp0", **VARIANTS["si_event_jax"])
+    t0 = [r for r in recs0 if r["event"] == "telemetry"][0]
+    assert "exchange_inflight_hwm" not in t0["per_window"]
+
+
 def test_exhausted_reason_on_fast_path(tmp_path):
     out, recs, res = _capture(tmp_path, "die", **VARIANTS["dieout_jax"])
     assert not res.converged
