@@ -115,7 +115,7 @@ def pool_retry(fn, *args, name: str = "", retries: int = 3,
 # every dated skip record so a BENCH_SELF_rNN.json names WHICH session
 # failed to reach hardware, and diffed against queued_since below to
 # render how many consecutive sessions each queued row has waited.
-SESSION = "r13"
+SESSION = "r14"
 
 
 def session_number(tag: str) -> int:
@@ -183,6 +183,11 @@ QUEUED_HARDWARE_ROWS = (
      "what": "50M S=8 -exchange-pipeline double-vs-off same-seed "
              "wall-clock twins on a v5e-8 (the schedule is parity-pinned "
              "bit-identical on CPU; the overlap win needs real ICI)"},
+    {"row": "pushsum_50m_twins", "queued_since": "r14",
+     "capture": "capture_pushsum_50m",
+     "what": "50M PushSum sharded-vs-jax same-seed twins (exchange cost "
+             "of the 12-column mass payload + shard-invariance at scale; "
+             "CPU pins cover semantics only)"},
 )
 
 
@@ -725,6 +730,47 @@ def capture_multirumor(detail: dict, seed: int,
         detail[name] = row
 
 
+def capture_pushsum(detail: dict, seed: int, n: int | None = None) -> None:
+    """Numeric-gossip row (ISSUE 14): a 1M-node PushSum averaging run to
+    the 95% eps-band target -- the wall-clock cost of the sum-combine
+    drain and the (dim+1)x4-limb mail payload against the same kout
+    overlay the SI headline rides.  CPU hosts run the /100 twin
+    (tests/test_pushsum.py pins the small-n semantics and the
+    check_bench CPU row pins the exact trajectory)."""
+    if n is None:
+        n = 1_000_000 if jax.default_backend() == "tpu" else 10_000
+    cfg = Config(n=n, fanout=6, graph="kout", backend="jax", seed=seed,
+                 model="pushsum", droprate=0.0, crashrate=0.0,
+                 coverage_target=0.95, max_rounds=3000,
+                 progress=False).validate()
+    row = pool_retry(_bench_backend, cfg, name="pushsum_1m")
+    row["n"] = cfg.n
+    detail["pushsum_1m"] = row
+
+
+def capture_pushsum_50m(detail: dict, seed: int) -> None:
+    """TPU-only 50M numeric-gossip twin pair (ISSUE 14): the sharded
+    S=8 PushSum run against its single-chip jax twin at the SAME
+    n/graph/seed.  The pair bounds two claims at scale that CPU shims
+    cannot: the routed exchange's cost carrying the 12-column int32 mass
+    payload (vs SI's 1 id/lane), and the shard-count invariance of the
+    trajectory (the two rows must report identical ticks/coverage --
+    conservation makes any divergence a bug, not noise)."""
+    base = Config(n=50_000_000, fanout=6, graph="kout", seed=seed,
+                  model="pushsum", droprate=0.0, crashrate=0.0,
+                  coverage_target=0.95, max_rounds=3000,
+                  progress=False)
+    for name, cfg in (
+        ("pushsum_50m_jax", base.replace(backend="jax").validate()),
+        ("pushsum_50m_sharded", base.replace(backend="sharded").validate()),
+    ):
+        detail[name] = pool_retry(_bench_backend, cfg, name=name)
+    a, b = detail["pushsum_50m_jax"], detail["pushsum_50m_sharded"]
+    if all("skipped" not in r and "error" not in r for r in (a, b)):
+        a["acceptance"] = bool(a.get("ticks") == b.get("ticks")
+                               and a.get("coverage") == b.get("coverage"))
+
+
 def capture_serve_elasticity(detail: dict, seed: int) -> None:
     """Elastic serving row (ISSUE 11): the CI twin shape forced through
     one widen and one narrow, measuring reshard_pause_ms -- the wall-clock
@@ -1036,7 +1082,7 @@ def cpu_scale_rows(seed: int) -> list[tuple[str, Config]]:
     done) are exact functions of (code, seed) on any host -- a changed
     value IS a changed trajectory, caught without TPU hardware.  Spans
     the engine surface: event SI, ring SIR via erdos, multi-rumor
-    oneshot, and streaming injection."""
+    oneshot, streaming injection, and PushSum numeric gossip."""
     return [
         ("cpu_si_event_10k", Config(
             n=10_000, graph="kout", fanout=6, seed=seed, crashrate=0.01,
@@ -1055,6 +1101,10 @@ def cpu_scale_rows(seed: int) -> list[tuple[str, Config]]:
             stream_rate=50, seed=seed, crashrate=0.0,
             coverage_target=0.95, backend="jax", progress=False,
             max_rounds=3000)),
+        ("cpu_pushsum_10k", Config(
+            n=10_000, graph="kout", fanout=6, model="pushsum", seed=seed,
+            droprate=0.0, crashrate=0.0, coverage_target=0.95,
+            backend="jax", progress=False, max_rounds=3000)),
     ]
 
 
@@ -1107,6 +1157,9 @@ def main() -> int:
         # Multi-rumor serving rows (ISSUE 8): 1M R=16 oneshot + streaming
         # injection, scale-banded the same way.
         capture_multirumor(result["detail"], args.seed)
+        # Numeric-gossip row (ISSUE 14): 1M PushSum averaging to the
+        # eps-band target, scale-banded the same way.
+        capture_pushsum(result["detail"], args.seed)
         # Elastic serving row (ISSUE 11): forced widen+narrow reshard
         # pause + zero-loss invariant (skipped on single-device hosts).
         capture_serve_elasticity(result["detail"], args.seed)
@@ -1129,6 +1182,9 @@ def main() -> int:
             # 50M single- vs multi-rumor twins: the measured marginal
             # cost of the rumor axis at scale (ISSUE 8).
             capture_multirumor_50m(result["detail"], args.seed)
+            # 50M PushSum sharded-vs-jax twins (ISSUE 14): mass-payload
+            # exchange cost + shard-invariance at scale.
+            capture_pushsum_50m(result["detail"], args.seed)
             # -deliver-kernel fused-vs-XLA wall-clock twins at 50M/100M
             # (ISSUE 9; dated skips re-queue when the pool is down).
             capture_deliver_kernel_twins(result["detail"], args.seed)

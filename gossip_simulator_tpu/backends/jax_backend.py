@@ -27,7 +27,13 @@ class JaxStepper(Stepper):
     def init(self) -> None:
         cfg = self.cfg
         self.key = _rng.base_key(cfg.seed)
-        self._engine = event if cfg.engine_resolved == "event" else epidemic
+        if cfg.model == "pushsum":
+            from gossip_simulator_tpu.models import pushsum
+
+            self._engine = pushsum
+        else:
+            self._engine = event if cfg.engine_resolved == "event" \
+                else epidemic
         self._mean_delay = (
             (cfg.delaylow + cfg.delayhigh) / 2.0
             if cfg.effective_time_mode == "ticks" else 1.0)
@@ -392,10 +398,16 @@ class JaxStepper(Stepper):
         if "mail_ids" in tree:
             # Record the mail-ring geometry so a future build whose AUTO
             # slot-cap/chunk sizing differs can repack instead of rejecting
-            # the snapshot (see load_state_pytree).
+            # the snapshot (see load_state_pytree).  Pushsum sizes its
+            # slots for emission volume, so its own module is the
+            # geometry authority there.
             cfg, n = self.cfg, self.cfg.n
+            if cfg.model == "pushsum":
+                from gossip_simulator_tpu.models import pushsum as geo
+            else:
+                geo = event
             tree["mail_geom"] = np.asarray(
-                [event.slot_cap(cfg, n), event.drain_chunk(cfg, n)],
+                [geo.slot_cap(cfg, n), geo.drain_chunk(cfg, n)],
                 dtype=np.int64)
         # Phase-1 overlay drops live host-side, not in the device state --
         # persist them or a resumed run under-reports mailbox_dropped.
@@ -413,7 +425,12 @@ class JaxStepper(Stepper):
         cfg = self.cfg
         tree = prepare_restore_tree(tree, cfg, n_shards=1)
         self._mailbox_dropped = int(tree.pop("host_mailbox_dropped", 0))
-        cls = EventState if cfg.engine_resolved == "event" else SimState
+        if cfg.model == "pushsum":
+            from gossip_simulator_tpu.models.pushsum import PushSumState
+
+            cls = PushSumState
+        else:
+            cls = EventState if cfg.engine_resolved == "event" else SimState
         # jax.numpy.array (device COPY), not asarray: on the CPU platform
         # asarray of a host array can be zero-copy, and these leaves feed
         # straight into DONATING jitted fns -- XLA then reuses a buffer it

@@ -68,7 +68,16 @@ class ShardedStepper(Stepper):
         else:
             self._telem = None
         telem_on = self._telem is not None
-        if cfg.engine_resolved == "event":
+        if cfg.model == "pushsum":
+            from gossip_simulator_tpu.parallel import pushsum_sharded
+
+            self._window_fn = pushsum_sharded.make_window_fn(
+                cfg, self.mesh, self._window)
+            self._seed_fn = pushsum_sharded.make_seed_fn(cfg, self.mesh)
+            self._run_fn = pushsum_sharded.make_run_to_coverage_fn(
+                cfg, self.mesh, telemetry=telem_on)
+            init_fn = pushsum_sharded.make_sharded_pushsum_init
+        elif cfg.engine_resolved == "event":
             from gossip_simulator_tpu.parallel import event_sharded
 
             self._window_fn = event_sharded.make_window_fn(
@@ -393,12 +402,15 @@ class ShardedStepper(Stepper):
             return None
         tree = {k: _host_gather(v) for k, v in self.state._asdict().items()}
         if "mail_ids" in tree:
-            from gossip_simulator_tpu.models import event
-
             cfg = self.cfg
+            if cfg.model == "pushsum":
+                from gossip_simulator_tpu.models import pushsum as geo
+            else:
+                from gossip_simulator_tpu.models import event as geo
+
             n_local = shard_size(cfg.n, self.mesh)
             tree["mail_geom"] = np.asarray(
-                [event.slot_cap(cfg, n_local), event.drain_chunk(cfg, n_local),
+                [geo.slot_cap(cfg, n_local), geo.drain_chunk(cfg, n_local),
                  self.mesh.shape[AXIS]], dtype=np.int64)
         # Phase-1 overlay drops live host-side, not in the device state --
         # persist them or a resumed run under-reports mailbox_dropped.
@@ -422,7 +434,13 @@ class ShardedStepper(Stepper):
         cfg, mesh = self.cfg, self.mesh
         tree = prepare_restore_tree(tree, cfg, n_shards=mesh.shape[AXIS])
         self._mailbox_dropped = int(tree.pop("host_mailbox_dropped", 0))
-        if cfg.engine_resolved == "event":
+        if cfg.model == "pushsum":
+            from gossip_simulator_tpu.models.pushsum import PushSumState
+            from gossip_simulator_tpu.parallel import pushsum_sharded
+
+            cls = PushSumState
+            specs = pushsum_sharded.pushsum_state_specs(cfg)
+        elif cfg.engine_resolved == "event":
             cls, specs = EventState, event_sharded.event_state_specs(cfg)
         else:
             cls, specs = SimState, sharded_step.sim_state_specs(cfg)

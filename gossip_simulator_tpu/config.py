@@ -354,6 +354,21 @@ class Config:
     # -event-slot-cap) > table entry > registered default; the active
     # entry id (or "defaults") is stamped into resolved_gates().
     tuning_table: str = "auto"
+    # --- numeric gossip (ISSUE 14; models/pushsum.py) -------------------------
+    # Model family: "si" is the reference's 1-bit infection; "pushsum" runs
+    # Kempe-style PushSum averaging -- every node carries a (value, weight)
+    # mass vector in 64-bit fixed point (exact integer limbs, so shard-count
+    # invariance and conservation hold bit-exactly), keeps ceil(half) each
+    # window and pushes the rest split over its friends through the same
+    # mail ring / all_to_all; delivery is a commutative scatter-ADD instead
+    # of the SI first-touch-wins OR.  Convergence: max over live nodes of
+    # the relative error of value/weight vs the true network mean.
+    model: str = "si"
+    # PushSum payload dimensionality (value vector length, 1..8).
+    pushsum_dim: int = 2
+    # Convergence threshold: the run completes when every live node's
+    # estimate is within this relative error of the true mean.
+    pushsum_eps: float = 1e-3
 
     # --- derived --------------------------------------------------------------
     @property
@@ -608,6 +623,7 @@ class Config:
             "dup_suppress": self.dup_suppress_resolved,
             "multi_rumor": self.multi_rumor,
             "time_mode": self.effective_time_mode,
+            "model": self.model,
         }
         if self.backend in ("jax", "sharded"):
             try:
@@ -864,6 +880,63 @@ class Config:
                 raise ValueError(
                     "-traffic stream requires the event engine (the jitted "
                     "injection schedule rides the event window step)")
+        # --- numeric gossip (-model pushsum) ------------------------------
+        if self.model not in ("si", "pushsum"):
+            raise ValueError(
+                f"model must be si|pushsum, got {self.model!r}")
+        if self.model == "pushsum":
+            if self.backend not in ("jax", "sharded"):
+                raise ValueError(
+                    "-model pushsum requires backend=jax or sharded (the "
+                    "discrete-event oracles are 1-bit SI only)")
+            if self.graph not in ("kout", "erdos"):
+                raise ValueError(
+                    "-model pushsum supports -graph kout|erdos (the rounds "
+                    "overlay build has no numeric state to average)")
+            if self.protocol != "si":
+                raise ValueError(
+                    "-model pushsum replaces the infection protocol; use "
+                    "the default -protocol si")
+            if self.effective_time_mode != "ticks":
+                raise ValueError("-model pushsum requires -time-mode ticks")
+            if self.engine_resolved != "event":
+                raise ValueError(
+                    "-model pushsum rides the event-engine mail ring; "
+                    "leave -engine auto/event")
+            if self.multi_rumor or self.traffic != "oneshot":
+                raise ValueError(
+                    "-model pushsum is incompatible with -rumors > 1 / "
+                    "-traffic stream (mass columns replace the rumor words)")
+            if self.compat_reference:
+                raise ValueError(
+                    "-compat-reference is strictly 1-bit SI; it has no "
+                    "PushSum surface")
+            if self.dup_suppress == "on":
+                raise ValueError(
+                    "-dup-suppress on is meaningless under -model pushsum: "
+                    "every delivery carries fresh mass, nothing is a "
+                    "guaranteed duplicate")
+            if self.droprate != 0.0:
+                raise ValueError(
+                    "-model pushsum requires droprate 0 (a dropped message "
+                    "destroys mass and breaks the conservation invariant; "
+                    "model lossy links with -scenario partitions instead, "
+                    "which block at send time)")
+            if self.crashrate != 0.0:
+                raise ValueError(
+                    "-model pushsum requires crashrate 0 (per-reception "
+                    "crashes black-hole in-flight mass; use -scenario "
+                    "crash/churn events -- crashed nodes park mass and "
+                    "rejoin with it)")
+            if self.serve:
+                raise ValueError("-serve streams rumors; it has no "
+                                 "pushsum surface")
+            if not 1 <= self.pushsum_dim <= 8:
+                raise ValueError(
+                    f"pushsum_dim must be in [1, 8], got {self.pushsum_dim}")
+            if not self.pushsum_eps > 0.0:
+                raise ValueError(
+                    f"pushsum_eps must be > 0, got {self.pushsum_eps}")
         # --- elastic serving / arrival processes --------------------------
         if self.arrivals not in ("fixed", "poisson", "burst", "diurnal"):
             raise ValueError(
@@ -1231,6 +1304,22 @@ def _build_parser() -> argparse.ArgumentParser:
                    default=d.ckpt_keep,
                    help="keep only the newest K checkpoint snapshots after "
                         "each successful save (0 = keep all)")
+    p.add_argument("-model", "--model", choices=("si", "pushsum"),
+                   default=d.model,
+                   help="model family: si = the reference's 1-bit "
+                        "infection; pushsum = numeric PushSum averaging "
+                        "(nodes push half their (value, weight) mass to "
+                        "friends each window; delivery is a scatter-add; "
+                        "the run converges when every live node's estimate "
+                        "is within -pushsum-eps of the true mean)")
+    p.add_argument("-pushsum-dim", "--pushsum-dim", dest="pushsum_dim",
+                   type=int, default=d.pushsum_dim,
+                   help="pushsum value-vector length (1..8)")
+    p.add_argument("-pushsum-eps", "--pushsum-eps", dest="pushsum_eps",
+                   type=float, default=d.pushsum_eps,
+                   help="pushsum convergence threshold: max relative "
+                        "error of any live node's estimate vs the true "
+                        "network mean")
     p.add_argument("-tuning-table", "--tuning-table", dest="tuning_table",
                    default=d.tuning_table,
                    help="tuned-constant table (scripts/autotune.py): auto "

@@ -398,6 +398,10 @@ def _run(cfg: Config, printer: ProgressPrinter,
         # schedule, and latency is measured against what actually ran.
         payload.update(_multi_rumor_report(live_cfg, stepper, stats,
                                            coverage_ms))
+    if cfg.model == "pushsum" and not p1_interrupted:
+        from gossip_simulator_tpu.models import pushsum
+
+        payload.update(pushsum.report(stepper))
     if telem is not None:
         payload["phases_s"] = {k: round(v, 6)
                                for k, v in sorted(telem.phases.items())}

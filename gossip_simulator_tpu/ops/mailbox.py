@@ -84,6 +84,27 @@ def ring_append(rings, cnt, dropped, payloads, wslot, valid, dw: int,
     return rings, cnt, dropped
 
 
+def deposit_sum(acc, dst, rows, valid, kernel: str = "xla"):
+    """Sum-combine delivery for the numeric gossip family (models/pushsum):
+    acc[dst[i]] += rows[i] for every True in `valid` -- the associative
+    scatter-ADD sibling of the SI drain's first-touch-wins OR.  Integer adds
+    commute, so arrival order (routing, chunking, shard count) never moves
+    the result -- the property the pushsum S=1 == S=8 bit-identity pin rests
+    on.  `acc` is (n, C) int32 fixed-point limbs; `rows` is (m, C).
+
+    `kernel="pallas"` routes through the fused deposit
+    (ops/pallas_deliver.fused_deposit_rows, the multi-rumor deposit's
+    in-register combine, here with a 1-deep slot axis) -- same combine mode
+    table as the OR path, gated by -deliver-kernel."""
+    n = acc.shape[0]
+    d = jnp.where(valid, dst, n)
+    if kernel == "pallas":
+        from gossip_simulator_tpu.ops import pallas_deliver
+        return pallas_deliver.fused_deposit_rows(
+            acc[None], jnp.zeros_like(d), d, rows)[0]
+    return acc.at[d].add(jnp.where(valid[:, None], rows, 0), mode="drop")
+
+
 def segment_ranks(sorted_keys: jnp.ndarray) -> jnp.ndarray:
     """Rank of each element within its run of equal values (input sorted).
 

@@ -157,6 +157,22 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
             f"checkpoint was written by the {ckpt_engine} engine but "
             f"this run resolves to {cfg.engine_resolved}; pass "
             f"-engine {ckpt_engine} to restore it")
+    # Model gate (the word-width rejection pattern): pushsum snapshots
+    # carry fixed-point mass columns an epidemic run has no slot for, and
+    # an epidemic snapshot has no mass to average -- both directions are
+    # rejected by name rather than coerced.
+    ckpt_pushsum = "mass" in tree
+    if ckpt_pushsum and cfg.model != "pushsum":
+        raise ValueError(
+            "checkpoint was written by the pushsum numeric-gossip model "
+            "(it carries fixed-point mass columns) but this run's model "
+            f"is {cfg.model}; pass -model pushsum to restore it")
+    if cfg.model == "pushsum" and not ckpt_pushsum:
+        raise ValueError(
+            "checkpoint was written by an epidemic-model run (it has no "
+            "mass columns) but this run has -model pushsum; restore it "
+            "without -model pushsum, or restart the pushsum run from "
+            "scratch")
     tree = dict(tree)
     if ckpt_engine == "event" and "received" in tree:
         # Pre-packed-flags event snapshot: fold the two bool arrays into
@@ -194,7 +210,18 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
             f"({int(np.asarray(tree['rumor_recv']).shape[0])} rumor "
             "lanes) but this run is single-rumor; restore with the "
             "snapshot's -rumors / -traffic flags")
-    if cfg.multi_rumor:
+    if cfg.model == "pushsum":
+        # No rumor axis to backfill -- PushSumState has no rumor leaves.
+        want_cols = np.asarray(tree["mass"]).shape[1]
+        from gossip_simulator_tpu.models import pushsum as _ps
+
+        if want_cols != _ps.mass_cols(cfg):
+            raise ValueError(
+                f"checkpoint mass is {want_cols} limb column(s) wide but "
+                f"-pushsum-dim {cfg.pushsum_dim} needs "
+                f"{_ps.mass_cols(cfg)}; restore with the snapshot's "
+                "-pushsum-dim")
+    elif cfg.multi_rumor:
         ckpt_w = int(np.asarray(tree["rumor_words"]).shape[1])
         if ckpt_w != cfg.rumor_word_count:
             raise ValueError(
@@ -216,11 +243,19 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
             if k not in tree:
                 tree[k] = v
     if ckpt_engine == "event":
+        if cfg.model == "pushsum":
+            # Pushsum sizes its ring for emission volume (every live node
+            # emits every window), so its own module is the geometry
+            # authority; the mail_mass limb columns ride the repack as the
+            # dtype-agnostic `words` companion.
+            from gossip_simulator_tpu.models import pushsum as geo
+        else:
+            geo = event
         n_local = n // n_shards
-        dw = event.ring_windows(cfg)
-        ncap = event.slot_cap(cfg, n_local)
-        nchunk = event.drain_chunk(cfg, n_local)
-        ntail = event.ring_tail(cfg, n_local)
+        dw = geo.ring_windows(cfg)
+        ncap = geo.slot_cap(cfg, n_local)
+        nchunk = geo.drain_chunk(cfg, n_local)
+        ntail = geo.ring_tail(cfg, n_local)
         per_new = dw * ncap + ntail
         geom = tree.pop("mail_geom", None)
         s_ckpt = (int(geom[2]) if geom is not None and len(geom) > 2 else 1)
@@ -260,8 +295,9 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                     f"checkpoint mail_ids length {mail_len} contradicts "
                     f"its stored geometry (cap={ocap}, chunk={ochunk}, "
                     f"{s_ckpt} shard(s))")
-            mw = (np.asarray(tree["mail_words"])
-                  if cfg.multi_rumor else None)
+            comp_key = ("mail_mass" if cfg.model == "pushsum"
+                        else ("mail_words" if cfg.multi_rumor else None))
+            mw = np.asarray(tree[comp_key]) if comp_key else None
             if s_ckpt != n_shards:
                 # Shard-count resharding (round 5): decode every in-flight
                 # entry to its GLOBAL destination, re-bucket under the new
@@ -271,11 +307,11 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                     np.asarray(tree["mail_ids"]),
                     np.asarray(tree["mail_cnt"]),
                     np.asarray(tree["sup_cnt"]), cfg, s_ckpt, n_shards,
-                    dw, ocap, otail, words=mw)
+                    dw, ocap, otail, words=mw, geom=geo)
                 tree["mail_ids"], tree["mail_cnt"] = mail2, cnt2
                 tree["sup_cnt"] = sup2
                 if mw2 is not None:
-                    tree["mail_words"] = mw2
+                    tree[comp_key] = mw2
                 tree["mail_dropped"] = np.asarray(
                     tree["mail_dropped"]) + np.int32(lost)
             elif per_old != per_new or ocap != ncap:
@@ -296,7 +332,7 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
                 tree["mail_ids"] = np.concatenate(mails)
                 tree["mail_cnt"] = np.stack(cnts)
                 if words:
-                    tree["mail_words"] = np.concatenate(words)
+                    tree[comp_key] = np.concatenate(words)
                 tree["mail_dropped"] = np.asarray(
                     tree["mail_dropped"]) + np.int32(lost)
     else:
@@ -461,7 +497,8 @@ def prepare_overlay_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
 
 def reshard_mail_rings(mail: np.ndarray, cnt: np.ndarray, sup: np.ndarray,
                        cfg, s_old: int, s_new: int, dw: int, ocap: int,
-                       otail: int, words: Optional[np.ndarray] = None):
+                       otail: int, words: Optional[np.ndarray] = None,
+                       geom=None):
     """Re-bucket S_old concatenated per-shard mail rings onto S_new shards
     (models/event.py packing: entry = dst_local * B + off, SIR triggers at
     trigger_base(n_local) + id * B + off -- both depend on the PER-SHARD
@@ -472,16 +509,20 @@ def reshard_mail_rings(mail: np.ndarray, cnt: np.ndarray, sup: np.ndarray,
     batch routing already performs.  Deferred duplicate credits (sup_cnt)
     are only ever summed across shards, so the per-slot totals land on
     shard 0.  Entries past the new slot capacity are dropped (counted).
-    `words` (multi-rumor payload word rings, same concatenated layout)
-    rides the identical re-bucketing.  Returns (mail, cnt, sup, lost,
-    words) in the new geometry (words None when not given)."""
+    `words` (multi-rumor payload word rings or pushsum mail_mass limbs,
+    same concatenated layout, dtype-agnostic) rides the identical
+    re-bucketing.  `geom` overrides the slot-geometry module (default the
+    event engine; pushsum snapshots pass their own module, whose ring is
+    sized for emission volume).  Returns (mail, cnt, sup, lost, words) in
+    the new geometry (words None when not given)."""
     from gossip_simulator_tpu.models import event
 
+    geo = geom if geom is not None else event
     n = cfg.n
-    b = event.batch_ticks(cfg)
+    b = geo.batch_ticks(cfg)
     nlo, nln = n // s_old, n // s_new
-    ncap = event.slot_cap(cfg, nln)
-    ntail = event.ring_tail(cfg, nln)
+    ncap = geo.slot_cap(cfg, nln)
+    ntail = geo.ring_tail(cfg, nln)
     per_old, per_new = dw * ocap + otail, dw * ncap + ntail
     sir = cfg.protocol == "sir"
     tbo, tbn = event.trigger_base(nlo, b), event.trigger_base(nln, b)

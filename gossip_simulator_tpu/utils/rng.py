@@ -28,6 +28,7 @@ OP_REMOVE = 10
 OP_DELAY_BK = 11  # overlay-ticks breakup-send delays (makeups use OP_DELAY)
 # 12-14 are claimed by scenario.py (OP_SCENARIO/OP_HEAL/OP_HEAL_SEND).
 OP_INJECT = 15  # multi-rumor source draws, keyed by rumor index (not tick)
+OP_PUSHSUM = 16  # pushsum per-window emission delays, (tick, GLOBAL id)-keyed
 
 
 def base_key(seed: int) -> jax.Array:

@@ -41,7 +41,7 @@ import numpy as np
 GOSSIP_COLS = ("tick", "received", "msg_hi", "msg_lo", "crashed", "removed",
                "mail_high", "dropped", "overflow", "scen_crashed",
                "recovered", "repaired", "part_dropped", "rumors_done",
-               "exchange_inflight_hwm")
+               "exchange_inflight_hwm", "relerr_ppb")
 OVERLAY_COLS = ("clock", "makeups", "breakups", "dropped")
 
 # Named column indices -- THE way to address a history column (schema v3
@@ -80,7 +80,7 @@ def record(hist: History, row) -> History:
 
 
 def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0,
-                 inflight_hwm: int = 0):
+                 inflight_hwm: int = 0, relerr=None):
     """One GOSSIP_COLS row from either epidemic engine's state (duck-typed
     like models/state.in_flight: EventState has the mail ring, SimState the
     pending ring).  `psum`/`pmax` are the sharded engines' cross-shard
@@ -93,7 +93,10 @@ def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0,
     routed path: 0 = no collective in the program (single device /
     non-sharded), 1 = the serial route->drain loop, 2 = the
     double-buffered pipeline (-exchange-pipeline double -- one staged
-    drain in flight behind the dispatched all_to_all)."""
+    drain in flight behind the dispatched all_to_all).  `relerr` is the
+    pushsum engines' per-window max relative error vs the true network
+    mean, pre-scaled to int32 parts-per-billion (already pmax-replicated
+    by the sharded step); None = not a numeric-gossip run, column 0."""
     import jax
     import jax.numpy as jnp
 
@@ -120,7 +123,8 @@ def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0,
     return [st.tick, st.total_received, msg[0], msg[1], st.total_crashed,
             removed, high, dropped, st.exchange_overflow,
             st.scen_crashed, st.scen_recovered, st.heal_repaired,
-            st.part_dropped, rdone, jnp.asarray(inflight_hwm, I32)]
+            st.part_dropped, rdone, jnp.asarray(inflight_hwm, I32),
+            jnp.asarray(relerr, I32) if relerr is not None else z]
 
 
 def overlay_probe(st):
@@ -357,6 +361,11 @@ class TelemetryReport:
                     # exchange ran (single-device builds record 0).
                     per["exchange_inflight_hwm"] = \
                         col("exchange_inflight_hwm").tolist()
+                if (cols.shape[1] > GCOL["relerr_ppb"]
+                        and bool(col("relerr_ppb").any())):
+                    # Numeric-gossip error column only on pushsum runs
+                    # (epidemic models record 0).
+                    per["relerr_ppb"] = col("relerr_ppb").tolist()
                 out["per_window"] = per
                 out["deltas"] = {
                     "received": np.diff(col("received"),

@@ -110,6 +110,13 @@ def main() -> int:
                 print("FAIL: header gossip columns missing "
                       "exchange_inflight_hwm")
                 ok = False
+            # Same contract for the numeric-gossip error column (ISSUE
+            # 14): named in the header on every run so pushsum JSONL
+            # consumers can key it positionally.
+            if "relerr_ppb" not in head.get(
+                    "columns", {}).get("gossip", []):
+                print("FAIL: header gossip columns missing relerr_ppb")
+                ok = False
         else:
             print("FAIL: JSONL stream does not open with the v3 header")
             ok = False

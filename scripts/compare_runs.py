@@ -43,7 +43,10 @@ STAT_FIELDS = ("round", "coverage", "converged", "reason",
                "exchange_overflow", "scen_crashed", "scen_recovered",
                "part_dropped", "heal_repaired", "exhausted",
                "rumors", "rumors_done", "shed", "fingerprint",
-               "fingerprint_windows")
+               "fingerprint_windows",
+               # Numeric-gossip (-model pushsum) result fields: absent on
+               # epidemic runs, compared when either side carries them.
+               "converged_eps", "eps_ticks", "relerr_ppb")
 
 
 def _first_divergent_window(ta, tb) -> list[str]:
