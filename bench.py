@@ -115,7 +115,7 @@ def pool_retry(fn, *args, name: str = "", retries: int = 3,
 # every dated skip record so a BENCH_SELF_rNN.json names WHICH session
 # failed to reach hardware, and diffed against queued_since below to
 # render how many consecutive sessions each queued row has waited.
-SESSION = "r14"
+SESSION = "r16"
 
 
 def session_number(tag: str) -> int:
@@ -188,6 +188,12 @@ QUEUED_HARDWARE_ROWS = (
      "what": "50M PushSum sharded-vs-jax same-seed twins (exchange cost "
              "of the 12-column mass payload + shard-invariance at scale; "
              "CPU pins cover semantics only)"},
+    {"row": "spatial_overhead_50m", "queued_since": "r16",
+     "capture": "capture_spatial_overhead_50m",
+     "what": "50M sharded S=8 spatial-panels on-vs-off same-seed twins "
+             "(the traffic matrix + shard/group panels' recording cost "
+             "over real ICI; the CPU spatial_overhead_1m twin bounds "
+             "only the single-chip scatter cost)"},
 )
 
 
@@ -771,6 +777,57 @@ def capture_pushsum_50m(detail: dict, seed: int) -> None:
                                and a.get("coverage") == b.get("coverage"))
 
 
+def capture_spatial_overhead(detail: dict, seed: int,
+                             n: int = 1_000_000) -> None:
+    """Spatial-telemetry overhead twins (ISSUE 16): the same seeded SI
+    run with `-telemetry-spatial` off vs on.  The panels ride the
+    existing per-window record as extra row scatters, so the on-run must
+    stay within 5% of the off-run's wall clock (the acceptance bound)
+    AND trajectory-identical (recording-invisible by construction --
+    tests/test_spatial.py pins the byte parity; this row pins the
+    cost)."""
+    cfg = Config(n=n, fanout=3, graph="kout", backend="jax", seed=seed,
+                 crashrate=0.001, coverage_target=0.90, max_rounds=3000,
+                 progress=False)
+    off = pool_retry(_bench_backend, cfg.validate(), name="spatial_off_1m")
+    on = pool_retry(_bench_backend,
+                    cfg.replace(telemetry_spatial="on").validate(),
+                    name="spatial_on_1m")
+    row = {"n": n, "off": off, "on": on}
+    if all("skipped" not in r and "error" not in r for r in (off, on)):
+        ratio = ((on.get("run_s") or 0.0)
+                 / max(off.get("run_s") or 0.0, 1e-9))
+        row["overhead_ratio"] = round(ratio, 4)
+        row["acceptance"] = bool(
+            ratio <= 1.05
+            and off.get("ticks") == on.get("ticks")
+            and off.get("coverage") == on.get("coverage"))
+    detail["spatial_overhead_1m"] = row
+
+
+def capture_spatial_overhead_50m(detail: dict, seed: int) -> None:
+    """TPU-only 50M sharded spatial twins (queued row): same on/off pair
+    as spatial_overhead_1m but S=8 over real ICI, where the panels also
+    count the traffic matrix inside the routed all_to_all -- the cost
+    the 1M single-chip twin cannot see."""
+    base = Config(n=50_000_000, fanout=6, graph="kout", backend="sharded",
+                  seed=seed, crashrate=0.0, coverage_target=0.95,
+                  max_rounds=3000, progress=False)
+    for name, cfg in (
+        ("spatial_50m_off", base.validate()),
+        ("spatial_50m_on",
+         base.replace(telemetry_spatial="on").validate()),
+    ):
+        detail[name] = pool_retry(_bench_backend, cfg, name=name)
+    a, b = detail["spatial_50m_off"], detail["spatial_50m_on"]
+    if all("skipped" not in r and "error" not in r for r in (a, b)):
+        ratio = (b.get("run_s") or 0.0) / max(a.get("run_s") or 0.0, 1e-9)
+        detail["spatial_overhead_50m"] = {
+            "overhead_ratio": round(ratio, 4),
+            "acceptance": bool(ratio <= 1.05
+                               and a.get("ticks") == b.get("ticks"))}
+
+
 def capture_serve_elasticity(detail: dict, seed: int) -> None:
     """Elastic serving row (ISSUE 11): the CI twin shape forced through
     one widen and one narrow, measuring reshard_pause_ms -- the wall-clock
@@ -1163,6 +1220,9 @@ def main() -> int:
         # Elastic serving row (ISSUE 11): forced widen+narrow reshard
         # pause + zero-loss invariant (skipped on single-device hosts).
         capture_serve_elasticity(result["detail"], args.seed)
+        # Spatial-telemetry on/off twins (ISSUE 16): panels must cost
+        # <= 5% wall clock and leave the trajectory untouched.
+        capture_spatial_overhead(result["detail"], args.seed)
         if jax.default_backend() == "tpu":
             # Distributional validation of the Pallas generators on real
             # hardware (interpret-mode CI can only check structure); also
@@ -1185,6 +1245,9 @@ def main() -> int:
             # 50M PushSum sharded-vs-jax twins (ISSUE 14): mass-payload
             # exchange cost + shard-invariance at scale.
             capture_pushsum_50m(result["detail"], args.seed)
+            # 50M sharded spatial on/off twins (ISSUE 16): the traffic
+            # matrix's recording cost over real ICI.
+            capture_spatial_overhead_50m(result["detail"], args.seed)
             # -deliver-kernel fused-vs-XLA wall-clock twins at 50M/100M
             # (ISSUE 9; dated skips re-queue when the pool is down).
             capture_deliver_kernel_twins(result["detail"], args.seed)

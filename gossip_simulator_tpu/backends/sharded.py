@@ -64,7 +64,8 @@ class ShardedStepper(Stepper):
         if cfg.telemetry_enabled:
             from gossip_simulator_tpu.utils.telemetry import TelemetrySession
 
-            self._telem = TelemetrySession(cfg)
+            self._telem = TelemetrySession(
+                cfg, n_shards=int(self.mesh.shape[AXIS]))
         else:
             self._telem = None
         telem_on = self._telem is not None
@@ -247,15 +248,18 @@ class ShardedStepper(Stepper):
         n_local = shard_size(cfg.n, mesh)
         from jax.sharding import PartitionSpec as P
 
+        n_shards = int(mesh.shape[AXIS])
         if cfg.engine_resolved == "event":
             from gossip_simulator_tpu.models import event as _event
             from gossip_simulator_tpu.parallel import event_sharded
 
-            build = _event.init_state
+            def build(c, friends, cnt):
+                return _event.init_state(c, friends, cnt, n_shards=n_shards)
             out_specs = event_sharded.event_state_specs(cfg)
         else:
             def build(c, friends, cnt):
-                return epidemic.init_state(c, friends, cnt, n_local=n_local)
+                return epidemic.init_state(c, friends, cnt, n_local=n_local,
+                                           n_shards=n_shards)
             out_specs = sharded_step.sim_state_specs(cfg)
 
         from gossip_simulator_tpu.parallel.mesh import shard_map

@@ -267,6 +267,14 @@ class Config:
     # pay the windowed host loop).  jax/sharded backends only; the
     # discrete-event oracles have no device loop to instrument.
     telemetry: str = "on"
+    # Spatial telemetry panels (utils/telemetry.py spatial_*): per-group /
+    # per-shard per-window panels plus the exchange traffic matrix,
+    # recorded on device next to the scalar history and fetched in the
+    # same single transfer.  npz-only (run dirs / utils/health.py): the
+    # stdout/JSONL surface is byte-identical on and off, and "off" traces
+    # the pre-spatial program (trajectory pins in tests/test_spatial.py).
+    # Requires -telemetry on; jax/sharded backends only.
+    telemetry_spatial: str = "off"
     # --- fault-injection scenario (gossip_simulator_tpu/scenario.py) --------
     # "off" (default: traced programs identical to a scenario-less build),
     # a path to a JSON timeline, or the JSON inline.  Schedules crash
@@ -513,6 +521,13 @@ class Config:
         `telemetry` field): jax/sharded only -- the oracles' windowed loop
         IS their only loop."""
         return self.telemetry != "off" and self.backend in ("jax", "sharded")
+
+    @property
+    def telemetry_spatial_enabled(self) -> bool:
+        """Whether the device-side loops also record the spatial panels
+        (per-group / per-shard / traffic-matrix histories).  Rides the
+        telemetry fast path, so it inherits telemetry_enabled's gating."""
+        return self.telemetry_spatial == "on" and self.telemetry_enabled
 
     @property
     def overlay_mode_resolved(self) -> str:
@@ -812,6 +827,18 @@ class Config:
         if self.telemetry not in ("on", "off"):
             raise ValueError(
                 f"telemetry must be on|off, got {self.telemetry!r}")
+        if self.telemetry_spatial not in ("on", "off"):
+            raise ValueError(f"telemetry_spatial must be on|off, got "
+                             f"{self.telemetry_spatial!r}")
+        if self.telemetry_spatial == "on" and self.telemetry == "off":
+            raise ValueError(
+                "-telemetry-spatial on records panels on the telemetry "
+                "fast path; it cannot run with -telemetry off")
+        if (self.telemetry_spatial == "on"
+                and self.backend not in ("jax", "sharded")):
+            raise ValueError(
+                "-telemetry-spatial needs a device-side loop to record "
+                f"panels; backend {self.backend!r} has none")
         for name in ("overlay_adaptive_chunks", "overlay_dead_skip",
                      "overlay_static_boot"):
             v = getattr(self, name)
@@ -1234,6 +1261,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="device-resident per-window telemetry on fast-path "
                         "runs (jax/sharded); off restores the windowed "
                         "host loop for observing runs")
+    p.add_argument("-telemetry-spatial", "--telemetry-spatial",
+                   dest="telemetry_spatial", choices=("on", "off"),
+                   default=d.telemetry_spatial,
+                   help="per-group/per-shard panels + exchange traffic "
+                        "matrix recorded next to the scalar history "
+                        "(npz-only; stdout/JSONL unchanged)")
     p.add_argument("-telemetry-summary", "--telemetry-summary",
                    dest="telemetry_summary", action="store_true",
                    help="print the end-of-run telemetry block (phase "

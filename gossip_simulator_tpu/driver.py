@@ -473,6 +473,14 @@ def _write_run_dir(cfg: Config, telem, window_rows: list, payload: dict,
     rdir.write_config(cfg)
     rdir.write_env()
     rdir.write_telemetry(hist_o, hist_g, traj)
+    if cfg.telemetry_spatial_enabled:
+        # Shard-health watchdog over the fetched panels: verdict to
+        # health.json, findings to the flight recorder as instants.
+        from gossip_simulator_tpu.utils import health as _health
+
+        n_shards = getattr(telem, "n_shards", 1) if telem is not None else 1
+        rdir.write_health(_health.report_health(_health.evaluate_health(
+            hist_g, cap=_health.ring_slot_cap(cfg, n_shards))))
     if serve_report is not None:
         rdir.write_serve(serve_report)
     rdir.write_result({

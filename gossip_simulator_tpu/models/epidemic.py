@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from gossip_simulator_tpu import scenario as _scen
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models.state import (SimState, in_flight,
+                                               init_exch_counts,
                                                msg64_add, msg64_zero)
 from gossip_simulator_tpu.ops.select import first_true_indices  # noqa: F401  (re-export: compaction callers import it from here)
 from gossip_simulator_tpu.utils import rng as _rng
@@ -91,7 +92,7 @@ def pack_rumor_bits(bits: jnp.ndarray, w: int) -> jnp.ndarray:
 
 
 def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
-               n_local: int | None = None) -> SimState:
+               n_local: int | None = None, n_shards: int = 1) -> SimState:
     n = n_local if n_local is not None else cfg.n
     d = ring_depth(cfg)
     d_rb = d if cfg.protocol == "sir" else 1
@@ -114,6 +115,7 @@ def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
         heal_repaired=z(),
         pending_rumors=pending_rumors, rumor_words=rumor_words,
         rumor_recv=rumor_recv, rumor_done=rumor_done,
+        exch_counts=init_exch_counts(cfg, n_shards),
     )
 
 
@@ -861,6 +863,7 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
         from gossip_simulator_tpu.utils import telemetry as telem
 
         sir = cfg.protocol == "sir"
+        spatial = telem.spatial_spec(cfg)
 
         @functools.partial(jax.jit, donate_argnums=(0, 4))
         def run_fn_t(st: SimState, base_key: jax.Array,
@@ -873,8 +876,9 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
             def body(carry):
                 s, h = carry
                 s = run_window(s, base_key)
-                return s, telem.record(h, telem.gossip_probe(
-                    s, sir, rumors=rumors if multi else 0))
+                row = telem.gossip_probe(
+                    s, sir, rumors=rumors if multi else 0)
+                return s, telem.record_window(h, row, st=s, spec=spatial)
 
             return jax.lax.while_loop(cond, body, (st, hist))
 

@@ -79,6 +79,7 @@ from gossip_simulator_tpu.models import epidemic
 # in_flight: canonical engine-agnostic definition in models/state.py,
 # re-exported here for the backends that import event.in_flight.
 from gossip_simulator_tpu.models.state import (in_flight,  # noqa: F401
+                                               init_exch_counts,
                                                msg64_add, msg64_zero)
 from gossip_simulator_tpu.utils import rng as _rng
 
@@ -145,6 +146,9 @@ class EventState(NamedTuple):
     # (lanes >= R stay 0 / -1).  Replicated across shards (psum'd deltas).
     rumor_recv: jnp.ndarray  # int32[W * 32 | 1]
     rumor_done: jnp.ndarray  # int32[W * 32 | 1]  tick coverage hit, -1 else
+    # Spatial-telemetry routed-exchange counters (state.init_exch_counts;
+    # 1x1 placeholder unless the panels record under S > 1 shards).
+    exch_counts: jnp.ndarray  # int32[1, S+2 | 1x1]
 
 
 def batch_ticks(cfg: Config, n_local: int | None = None) -> int:
@@ -425,7 +429,7 @@ def stamp_rumor_done(cfg: Config, rumor_recv, rumor_done, tick):
 
 
 def init_state(cfg: Config, friends: jnp.ndarray,
-               friend_cnt: jnp.ndarray) -> EventState:
+               friend_cnt: jnp.ndarray, n_shards: int = 1) -> EventState:
     n = friends.shape[0]  # local rows: the shard slice under the sharded backend
     z = lambda: jnp.zeros((), I32)
     mail_words, rumor_words, rumor_recv, rumor_done = init_rumor_leaves(
@@ -447,6 +451,7 @@ def init_state(cfg: Config, friends: jnp.ndarray,
         heal_repaired=z(),
         mail_words=mail_words, rumor_words=rumor_words,
         rumor_recv=rumor_recv, rumor_done=rumor_done,
+        exch_counts=init_exch_counts(cfg, n_shards),
     )
 
 
@@ -1580,6 +1585,7 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
         from gossip_simulator_tpu.utils import telemetry as telem
 
         sir = cfg.protocol == "sir"
+        spatial = telem.spatial_spec(cfg)
 
         @functools.partial(jax.jit, donate_argnums=(0, 4))
         def run_fn_t(st: EventState, base_key: jax.Array,
@@ -1592,8 +1598,9 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
             def body(carry):
                 s, h = carry
                 s = run_window(s, base_key)
-                return s, telem.record(h, telem.gossip_probe(
-                    s, sir, rumors=rumors if multi else 0))
+                row = telem.gossip_probe(
+                    s, sir, rumors=rumors if multi else 0)
+                return s, telem.record_window(h, row, st=s, spec=spatial)
 
             return jax.lax.while_loop(cond, body, (st, hist))
 

@@ -60,7 +60,8 @@ import numpy as np
 from gossip_simulator_tpu import scenario as _scen
 from gossip_simulator_tpu.config import Config
 from gossip_simulator_tpu.models import event
-from gossip_simulator_tpu.models.state import in_flight, msg64_add, msg64_zero
+from gossip_simulator_tpu.models.state import (in_flight, init_exch_counts,
+                                               msg64_add, msg64_zero)
 from gossip_simulator_tpu.utils import rng as _rng
 
 I32 = jnp.int32
@@ -100,6 +101,9 @@ class PushSumState(NamedTuple):
     heal_repaired: jnp.ndarray  # int32[]
     relerr_ppb: jnp.ndarray  # int32[]  last window's live max rel-err, ppb
     eps_tick: jnp.ndarray  # int32[]  first tick with eps-band count >= target; -1
+    # Spatial-telemetry routed-exchange counters (state.init_exch_counts;
+    # 1x1 placeholder unless the panels record under S > 1 shards).
+    exch_counts: jnp.ndarray  # int32[1, S+2 | 1x1]
 
 
 # --- geometry ----------------------------------------------------------------
@@ -285,7 +289,7 @@ def init_mass(cfg: Config, gid0, rows: int):
 # --- state -------------------------------------------------------------------
 
 def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
-               gid0=0) -> PushSumState:
+               gid0=0, n_shards: int = 1) -> PushSumState:
     n = friends.shape[0]  # local rows: the shard slice under sharded
     z = lambda: jnp.zeros((), I32)  # noqa: E731
     dw = ring_windows(cfg, n)
@@ -305,6 +309,7 @@ def init_state(cfg: Config, friends: jnp.ndarray, friend_cnt: jnp.ndarray,
         heal_repaired=z(),
         relerr_ppb=jnp.full((), 2_000_000_000, I32),
         eps_tick=jnp.full((), -1, I32),
+        exch_counts=init_exch_counts(cfg, n_shards),
     )
 
 
@@ -552,6 +557,8 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
     if telemetry:
         from gossip_simulator_tpu.utils import telemetry as telem
 
+        spatial = telem.spatial_spec(cfg)
+
         @functools.partial(jax.jit, donate_argnums=(0, 4))
         def run_fn_t(st: PushSumState, base_key: jax.Array,
                      target_count: jax.Array, until: jax.Array,
@@ -563,8 +570,9 @@ def make_run_to_coverage_fn(cfg: Config, telemetry: bool = False):
             def body(carry):
                 s, h = carry
                 s = run_window(s, base_key)
-                return s, telem.record(h, telem.gossip_probe(
-                    s, False, relerr=s.relerr_ppb))
+                row = telem.gossip_probe(s, False, relerr=s.relerr_ppb)
+                return s, telem.record_window(h, row, st=s, spec=spatial,
+                                              relerr=s.relerr_ppb)
 
             return jax.lax.while_loop(cond, body, (st, hist))
 

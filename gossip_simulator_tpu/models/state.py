@@ -89,6 +89,23 @@ class SimState(NamedTuple):
     rumor_words: jnp.ndarray  # uint32[n, W | 1x1]  per-node rumor bitmask
     rumor_recv: jnp.ndarray  # int32[W*32 | 1]  per-rumor infected count
     rumor_done: jnp.ndarray  # int32[W*32 | 1]  tick rumor hit target (-1)
+    # --- spatial telemetry (Config.telemetry_spatial) --------------------
+    # Cumulative routed-exchange counters, int32[1, S+2] when the spatial
+    # panels are on under S > 1 shards ([:S] delivered sends per dest
+    # shard, [S] deliveries received, [S+1] bucket overflow), a 1x1
+    # placeholder otherwise (the down_since convention).  Node-axis
+    # leading like mail_cnt so shards stack to (S, S+2) under P(AXIS,).
+    exch_counts: jnp.ndarray  # int32[1, S+2 | 1x1]
+
+
+def init_exch_counts(cfg, n_shards: int = 1) -> jnp.ndarray:
+    """Per-shard routed-exchange counter leaf (see SimState.exch_counts).
+    Full (1, S+2) only when the spatial panels record under a sharded
+    run; every other build keeps the 1x1 placeholder so the default
+    program traces no counting op."""
+    w = (n_shards + 2
+         if (cfg.telemetry_spatial_enabled and n_shards > 1) else 1)
+    return jnp.zeros((1, w), jnp.int32)
 
 
 def in_flight(st) -> jnp.ndarray:

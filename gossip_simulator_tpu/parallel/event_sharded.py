@@ -96,6 +96,9 @@ def event_state_specs(cfg: Config) -> EventState:
         mail_words=P(AXIS, None) if multi else P(),
         rumor_words=P(AXIS, None) if multi else P(),
         rumor_recv=P(), rumor_done=P(),
+        # Per-shard exchange counters stack to (S, S+2) like mail_cnt
+        # (the 1x1 off-path placeholder splits the same way to (S, 1)).
+        exch_counts=P(AXIS, None),
     )
 
 
@@ -109,13 +112,14 @@ def make_sharded_event_init(cfg: Config, mesh):
     """Per-shard graph slice + event state (row-keyed generators make this
     bit-identical to slicing a single-device generation)."""
     n_local = shard_size(cfg.n, mesh)
+    n_shards = mesh.shape[AXIS]
 
     def init_shard():
         shard = jax.lax.axis_index(AXIS)
         key = graphs.graph_key(cfg)
         friends, cnt = graphs.generate(cfg, key, row0=shard * n_local,
                                        rows=n_local)
-        return event.init_state(cfg, friends, cnt)
+        return event.init_state(cfg, friends, cnt, n_shards=n_shards)
 
     return jax.jit(_shard_map(mesh, init_shard, in_specs=(),
                               out_specs=event_state_specs(cfg)))
@@ -222,6 +226,9 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
             cfg, n_local, mail, cnt, dropped, dst_global * b + off, wslot,
             valid)
         return mail, cnt, dropped, xovf, sup_adds
+    # xovf may be the (scalar, exch_counts) pair the spatial panels
+    # thread through the emission carries (exchange.ovf_split).
+    xo, exch = exchange.ovf_split(xovf)
     dest = jnp.where(valid, dst_global // n_local, n_shards)
     wire = jnp.where(
         valid,
@@ -230,11 +237,14 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
         payloads = (wire,) + tuple(
             jax.lax.bitcast_convert_type(words[:, i], I32)
             for i in range(words.shape[1]))
-        recvs, ovf = exchange.route_multi(payloads, dest, valid, n_shards,
-                                          rcap)
+        out = exchange.route_multi(payloads, dest, valid, n_shards,
+                                   rcap, traffic=exch)
+        (recvs, ovf), exch = out[:2], out[2] if exch is not None else None
         recv = recvs[0]
     else:
-        recv, ovf = exchange.route_one(wire, dest, valid, n_shards, rcap)
+        out = exchange.route_one(wire, dest, valid, n_shards, rcap,
+                                 traffic=exch)
+        (recv, ovf), exch = out[:2], out[2] if exch is not None else None
     rvalid = recv >= 0
     r = jnp.maximum(recv, 0)
     rdstl = r // (dw * b)
@@ -259,10 +269,12 @@ def _route_and_append(cfg: Config, n_shards: int, n_local: int, mail, cnt,
         mail, cnt, dropped, mail_words = _ring_append(
             cfg, n_local, mail, cnt, dropped, rdstl * b + roff, rw,
             rvalid, words=rwords, mail_words=mail_words)
-        return mail, cnt, dropped, xovf + ovf, sup_adds, mail_words
+        return (mail, cnt, dropped, exchange.ovf_join(xo + ovf, exch),
+                sup_adds, mail_words)
     mail, cnt, dropped = _ring_append(
         cfg, n_local, mail, cnt, dropped, rdstl * b + roff, rw, rvalid)
-    return mail, cnt, dropped, xovf + ovf, sup_adds
+    return (mail, cnt, dropped, exchange.ovf_join(xo + ovf, exch),
+            sup_adds)
 
 
 def _route_stage(cfg: Config, n_shards: int, n_local: int, xovf,
@@ -294,6 +306,8 @@ def _route_stage(cfg: Config, n_shards: int, n_local: int, xovf,
         sup_adds = ((wslot[:, None] == jnp.arange(dw, dtype=I32)[None, :])
                     & dup[:, None]).sum(axis=0, dtype=I32)
         valid = valid & ~dup
+    # xovf may be the (scalar, exch_counts) pair (exchange.ovf_split).
+    xo, exch = exchange.ovf_split(xovf)
     dest = jnp.where(valid, dst_global // n_local, n_shards)
     wire = jnp.where(
         valid,
@@ -304,8 +318,10 @@ def _route_stage(cfg: Config, n_shards: int, n_local: int, xovf,
             for i in range(words.shape[1]))
     else:
         payloads = (wire,)
-    recvs, ovf, pstage = exchange.route_multi_pipelined(
-        payloads, dest, valid, n_shards, rcap, pstage)
+    out = exchange.route_multi_pipelined(
+        payloads, dest, valid, n_shards, rcap, pstage, traffic=exch)
+    (recvs, ovf, pstage), exch = out[:3], (out[3] if exch is not None
+                                           else None)
     recv = recvs[0]
     rvalid = recv >= 0
     r = jnp.maximum(recv, 0)
@@ -325,7 +341,7 @@ def _route_stage(cfg: Config, n_shards: int, n_local: int, xovf,
              for c in recvs[1:]], axis=1)
         rwords = jnp.where(rvalid[:, None], rwords, jnp.uint32(0))
         stage = stage + (rwords,)
-    return xovf + ovf, sup_adds, stage, pstage
+    return exchange.ovf_join(xo + ovf, exch), sup_adds, stage, pstage
 
 
 def _flush_stage(cfg: Config, n_local: int, mail, cnt, dropped, stage,
@@ -891,12 +907,21 @@ def make_sharded_event_step(cfg: Config, mesh):
                 s * rcap,
                 trig_lanes=0 if multi else (ccap if sir else 0),
                 words_w=(st.mail_words.shape[1] if multi else 0)),)
+        # Spatial panels (S > 1): the exch_counts leaf rides the xovf
+        # carry position as a pair (exchange.ovf_split) so every route
+        # inside the chunk loop accumulates into it without widening any
+        # emission signature.
+        xv0 = ((z, st.exch_counts)
+               if cfg.telemetry_spatial_enabled and s > 1 else z)
         out = jax.lax.fori_loop(
             0, chunks, body,
             pack((st.flags, mail0, st.mail_cnt, st.sup_cnt,
-                  dm0, z, z, inj_drop, z), st.down_since, z, mt0))
+                  dm0, z, z, inj_drop, xv0), st.down_since, z, mt0))
         (flags, mail, cnt, sup, dm, dr, dc, ddrop,
          dxovf), down, part, mt = unpack(out)
+        dxovf, exch_new = exchange.ovf_split(dxovf)
+        if exch_new is not None:
+            st = st._replace(exch_counts=exch_new)
         if pipe_dense:
             if multi:
                 mw, rwd, rrc = mt[:3]
@@ -1004,11 +1029,17 @@ def make_sharded_event_seed(cfg: Config, mesh):
         rcap = min(exchange.epidemic_cap(n_local, kwidth, s), kwidth)
         # No suppression at seed time (flags=None): the only set received
         # bit is the seed's own and no generator produces self-edges.
+        xv0 = ((jnp.zeros((), I32), st.exch_counts)
+               if cfg.telemetry_spatial_enabled and s > 1
+               else jnp.zeros((), I32))
         mail, cnt, dropped, xovf, _ = _route_and_append(
             cfg, s, n_local, st.mail_ids, st.mail_cnt, jnp.zeros((), I32),
-            jnp.zeros((), I32), jnp.where(edge, sf, 0),
+            xv0, jnp.where(edge, sf, 0),
             jnp.broadcast_to((arrive // b) % dw, (kwidth,)),
             jnp.broadcast_to(arrive % b, (kwidth,)), edge, rcap)
+        xovf, exch_new = exchange.ovf_split(xovf)
+        if exch_new is not None:
+            st = st._replace(exch_counts=exch_new)
         if cfg.protocol == "sir":
             # The seed's removal draw decides its re-broadcast trigger
             # (replicated key; only the owner shard appends).
@@ -1066,6 +1097,9 @@ def make_sharded_event_heal(cfg: Config, mesh):
         off = jnp.broadcast_to((arrive % b)[:, None],
                                (n_local, k)).reshape(-1)
         rcap = min(exchange.epidemic_cap(n_local, k, s), n_local * k)
+        xv0 = ((jnp.zeros((), I32), st.exch_counts)
+               if cfg.telemetry_spatial_enabled and s > 1
+               else jnp.zeros((), I32))
         if cfg.multi_rumor:
             wc = st.rumor_words.shape[1]
             # Resends carry the healer's FULL rumor set (cross-shard via
@@ -1077,7 +1111,7 @@ def make_sharded_event_heal(cfg: Config, mesh):
                                   (n_local, k, wc)).reshape(-1, wc)
             mail, cnt, dropped, xovf, _, mailw = _route_and_append(
                 cfg, s, n_local, st.mail_ids, st.mail_cnt,
-                jnp.zeros((), I32), jnp.zeros((), I32),
+                jnp.zeros((), I32), xv0,
                 jnp.where(resend, friends, 0).reshape(-1),
                 wslot, off, resend.reshape(-1), rcap, words=rw,
                 mail_words=st.mail_words)
@@ -1094,7 +1128,7 @@ def make_sharded_event_heal(cfg: Config, mesh):
         else:
             mail, cnt, dropped, xovf, _ = _route_and_append(
                 cfg, s, n_local, st.mail_ids, st.mail_cnt,
-                jnp.zeros((), I32), jnp.zeros((), I32),
+                jnp.zeros((), I32), xv0,
                 jnp.where(resend, friends, 0).reshape(-1),
                 wslot, off, resend.reshape(-1), rcap)
             # Rejoin pull responses deliver to the puller's OWN row --
@@ -1104,6 +1138,9 @@ def make_sharded_event_heal(cfg: Config, mesh):
             mail, cnt, dropped = _ring_append(
                 cfg, n_local, mail, cnt, dropped, ppay, wslot,
                 pull.reshape(-1))
+        xovf, exch_new = exchange.ovf_split(xovf)
+        if exch_new is not None:
+            st = st._replace(exch_counts=exch_new)
         rep, blk, dropped, xovf = jax.lax.psum(
             (rep, jnp.asarray(blk, I32), dropped, xovf), AXIS)
         return st._replace(
@@ -1198,7 +1235,8 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
 
         sir = cfg.protocol == "sir"
         ihwm = exchange.inflight_hwm(cfg, mesh.shape[AXIS])
-        hspecs = telem.History(idx=P(), cols=P(None, None))
+        spatial = telem.spatial_spec(cfg, int(mesh.shape[AXIS]))
+        hspecs = telem.bundle_specs(spatial, P)
 
         @functools.partial(jax.jit, donate_argnums=(0, 4))
         def run_t(st: EventState, base_key, target_count, until, hist):
@@ -1215,7 +1253,11 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
                         pmax=lambda x: jax.lax.pmax(x, AXIS),
                         rumors=rumors if multi else 0,
                         inflight_hwm=ihwm)
-                    return s, telem.record(h, row)
+                    return s, telem.record_window(
+                        h, row, st=s, spec=spatial,
+                        shard_index=jax.lax.axis_index(AXIS),
+                        gather=lambda x: jax.lax.all_gather(x, AXIS),
+                        psum=lambda x: jax.lax.psum(x, AXIS))
 
                 return jax.lax.while_loop(cond, body, (st, hist))
 

@@ -49,7 +49,7 @@ RANK_MAX_SHARDS = 16
 
 def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
                 n_shards: int, cap: int, axis: str = AXIS,
-                sort_buckets: bool | None = None):
+                sort_buckets: bool | None = None, traffic=None):
     """Exchange several int32 payload arrays that share one (dest, valid)
     keying: one bucketing-rank pass carries all payloads, the per-payload
     buffers concatenate into a single all_to_all.
@@ -65,14 +65,24 @@ def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
             force the sort (True) / one-hot cumsum (False) rank path --
             the two produce bit-identical buffers (module docstring);
             the override exists for the profiler and the parity test.
+        traffic: None, or the caller's int32[1, S+2] spatial-telemetry
+            counter leaf (models/state.SimState.exch_counts).  When armed
+            the route also accumulates [:S] += delivered sends per
+            destination shard (overflowed lanes excluded, so column sums
+            of the traffic matrix equal receiver-side counts exactly),
+            [S] += deliveries received here, [S+1] += local bucket
+            overflow, and a 3rd value returns the updated leaf.  The
+            delivered payload bits are untouched either way.
 
     Returns:
         recvs: tuple of int32[S*cap] received payloads (-1 = empty slot),
             slot-aligned across payloads.
         overflow: int32[] messages dropped for capacity locally.
+        traffic: updated counter leaf -- ONLY when `traffic` was passed.
     """
-    stacked, overflow = _bucket_pack(payloads, dest_shard, valid, n_shards,
-                                     cap, sort_buckets)
+    stacked, overflow, sent = _bucket_pack(
+        payloads, dest_shard, valid, n_shards, cap, sort_buckets,
+        count_sent=traffic is not None)
     if n_shards > 1:
         recv = jax.lax.all_to_all(stacked, axis, split_axis=0,
                                   concat_axis=0, tiled=True)
@@ -83,14 +93,33 @@ def route_multi(payloads, dest_shard: jnp.ndarray, valid: jnp.ndarray,
         recv = stacked
     recvs = tuple(recv[:, i * cap:(i + 1) * cap].reshape(-1)
                   for i in range(len(payloads)))
-    return recvs, overflow
+    if traffic is None:
+        return recvs, overflow
+    return recvs, overflow, _traffic_update(traffic, sent, recvs[0],
+                                            overflow)
 
 
-def _bucket_pack(payloads, dest_shard, valid, n_shards, cap, sort_buckets):
+def _traffic_update(traffic, sent, recv0, overflow):
+    """Accumulate one route's counts into the int32[1, S+2] leaf: [:S]
+    delivered sends per destination, [S] deliveries received (valid slots
+    of the first payload -- slot-aligned, one message per slot), [S+1]
+    bucket overflow."""
+    got = (recv0 >= 0).sum(dtype=I32)
+    row = jnp.concatenate([sent, got[None], overflow[None]])
+    return traffic + row[None, :]
+
+
+def _bucket_pack(payloads, dest_shard, valid, n_shards, cap, sort_buckets,
+                 count_sent=False):
     """Bucket-by-destination rank + flat scatter into the [S, len(payloads)
     * cap] send buffer -- the pre-collective half of route_multi, split out
     so the pipelined route can order the pack against the previous batch's
-    staged drain.  Op-for-op the round-6 pack (bit-identical buffers)."""
+    staged drain.  Op-for-op the round-6 pack (bit-identical buffers).
+
+    Returns (stacked, overflow, sent): `sent` is the int32[S] delivered
+    (rank < cap) send count per destination shard when `count_sent`, else
+    None -- computed from the masks the pack already built, so the armed
+    path adds reductions only."""
     if sort_buckets is None:
         sort_buckets = n_shards > _tuning.value(
             "exchange.rank_max_shards", None, default=RANK_MAX_SHARDS)
@@ -107,6 +136,9 @@ def _bucket_pack(payloads, dest_shard, valid, n_shards, cap, sort_buckets):
         flat = jnp.where(ok, sk * cap + rank, n_shards * cap)  # trash cell
         vals = [jnp.where(ok, sp, -1) for sp in sps]
         overflow = ((sk < n_shards) & (rank >= cap)).sum(dtype=I32)
+        sent = (((sk[:, None] == jnp.arange(n_shards, dtype=I32)[None, :])
+                 & ok[:, None]).sum(axis=0, dtype=I32)
+                if count_sent else None)
     else:
         # Sort-free: rank within the destination bucket = count of earlier
         # valid entries with the same destination (masked cumsum over the
@@ -121,18 +153,20 @@ def _bucket_pack(payloads, dest_shard, valid, n_shards, cap, sort_buckets):
         flat = jnp.where(ok, key * cap + rank, n_shards * cap)
         vals = [jnp.where(ok, p.astype(I32), -1) for p in payloads]
         overflow = ((key < n_shards) & (rank >= cap)).sum(dtype=I32)
+        sent = ((oh * ok[:, None]).sum(axis=0, dtype=I32)
+                if count_sent else None)
     bufs = []
     for v in vals:
         buf = jnp.full((n_shards * cap + 1,), -1, I32)
         bufs.append(buf.at[flat].set(v)
                     [:n_shards * cap].reshape(n_shards, cap))
-    return jnp.concatenate(bufs, axis=1), overflow
+    return jnp.concatenate(bufs, axis=1), overflow, sent
 
 
 def route_multi_pipelined(payloads, dest_shard: jnp.ndarray,
                           valid: jnp.ndarray, n_shards: int, cap: int,
                           stage, axis: str = AXIS,
-                          sort_buckets: bool | None = None):
+                          sort_buckets: bool | None = None, traffic=None):
     """Double-buffered route_multi: pack this batch's send buffer, ORDER
     the pack before the previous batch's staged drain with
     `lax.optimization_barrier`, then dispatch the collective.
@@ -149,10 +183,13 @@ def route_multi_pipelined(payloads, dest_shard: jnp.ndarray,
     are exactly route_multi's.
 
     Returns (recvs, overflow, stage) -- recvs/overflow as route_multi,
-    stage the barrier-threaded carry to drain now.
+    stage the barrier-threaded carry to drain now.  With `traffic`
+    (route_multi's spatial counter leaf) a 4th value returns the updated
+    leaf.
     """
-    stacked, overflow = _bucket_pack(payloads, dest_shard, valid, n_shards,
-                                     cap, sort_buckets)
+    stacked, overflow, sent = _bucket_pack(
+        payloads, dest_shard, valid, n_shards, cap, sort_buckets,
+        count_sent=traffic is not None)
     leaves, treedef = jax.tree_util.tree_flatten(stage)
     if leaves:
         stacked, *leaves = jax.lax.optimization_barrier((stacked, *leaves))
@@ -164,7 +201,28 @@ def route_multi_pipelined(payloads, dest_shard: jnp.ndarray,
         recv = stacked
     recvs = tuple(recv[:, i * cap:(i + 1) * cap].reshape(-1)
                   for i in range(len(payloads)))
-    return recvs, overflow, stage
+    if traffic is None:
+        return recvs, overflow, stage
+    return recvs, overflow, stage, _traffic_update(traffic, sent, recvs[0],
+                                                   overflow)
+
+
+def ovf_split(xovf):
+    """View a threaded overflow carry as (scalar, traffic-or-None).
+
+    The sharded engines thread one exchange_overflow value positionally
+    through deep emission carries (fori bodies, batch loops, the pipeline
+    stage).  With the spatial panels armed that value becomes the pair
+    (overflow scalar, exch_counts leaf) so the traffic accumulator rides
+    the SAME positions untouched -- only the route helpers (which add to
+    it) and the window-step boundaries (seed / psum / state writeback)
+    ever look inside, via this pair of views."""
+    return xovf if isinstance(xovf, tuple) else (xovf, None)
+
+
+def ovf_join(ovf, traffic):
+    """Inverse of ovf_split: rebuild the threaded carry."""
+    return ovf if traffic is None else (ovf, traffic)
 
 
 def pipeline_enabled(cfg, n_shards: int) -> bool:
@@ -190,11 +248,16 @@ def inflight_hwm(cfg, n_shards: int) -> int:
 
 def route_one(payload: jnp.ndarray, dest_shard: jnp.ndarray,
               valid: jnp.ndarray, n_shards: int, cap: int,
-              axis: str = AXIS, sort_buckets: bool | None = None):
+              axis: str = AXIS, sort_buckets: bool | None = None,
+              traffic=None):
     """Exchange one int32 payload array (see route_multi)."""
-    (recv,), overflow = route_multi((payload,), dest_shard, valid, n_shards,
-                                    cap, axis, sort_buckets=sort_buckets)
-    return recv, overflow
+    out = route_multi((payload,), dest_shard, valid, n_shards,
+                      cap, axis, sort_buckets=sort_buckets, traffic=traffic)
+    if traffic is None:
+        (recv,), overflow = out
+        return recv, overflow
+    (recv,), overflow, traffic = out
+    return recv, overflow, traffic
 
 
 def epidemic_cap(n_local: int, k: int, n_shards: int, safety: int = 4) -> int:

@@ -55,6 +55,9 @@ def pushsum_state_specs(cfg: Config) -> PushSumState:
         scen_crashed=P(), scen_recovered=P(), part_dropped=P(),
         heal_repaired=P(),
         relerr_ppb=P(), eps_tick=P(),
+        # Per-shard exchange counters stack to (S, S+2); the 1x1
+        # off-path placeholder splits the same way to (S, 1).
+        exch_counts=P(AXIS, None),
     )
 
 
@@ -69,13 +72,15 @@ def make_sharded_pushsum_init(cfg: Config, mesh):
     generators and the gid-keyed mass hash make this bit-identical to
     slicing a single-device init."""
     n_local = shard_size(cfg.n, mesh)
+    n_shards = mesh.shape[AXIS]
 
     def init_shard():
         shard = jax.lax.axis_index(AXIS)
         key = graphs.graph_key(cfg)
         friends, cnt = graphs.generate(cfg, key, row0=shard * n_local,
                                        rows=n_local)
-        return pushsum.init_state(cfg, friends, cnt, gid0=shard * n_local)
+        return pushsum.init_state(cfg, friends, cnt, gid0=shard * n_local,
+                                  n_shards=n_shards)
 
     return jax.jit(_shard_map(mesh, init_shard, in_specs=(),
                               out_specs=pushsum_state_specs(cfg)))
@@ -109,11 +114,14 @@ def _route_append_mass(cfg: Config, s: int, n_local: int, mail, mailm,
             cfg, n_local, mail, mailm, cnt, dropped,
             dst_global * b + off, share, wslot, valid)
         return mail, mailm, cnt, dropped, xovf
+    xo, exch = exchange.ovf_split(xovf)
     dest = jnp.where(valid, dst_global // n_local, s)
     wire = jnp.where(
         valid, (dst_global % n_local) * (dw * b) + wslot * b + off, -1)
     payloads = (wire,) + tuple(share[:, i] for i in range(share.shape[1]))
-    recvs, ovf = exchange.route_multi(payloads, dest, valid, s, rcap)
+    out = exchange.route_multi(payloads, dest, valid, s, rcap,
+                               traffic=exch)
+    (recvs, ovf), exch = out[:2], (out[2] if exch is not None else None)
     recv = recvs[0]
     rvalid = recv >= 0
     r = jnp.maximum(recv, 0)
@@ -126,7 +134,7 @@ def _route_append_mass(cfg: Config, s: int, n_local: int, mail, mailm,
     mail, mailm, cnt, dropped = _mass_append(
         cfg, n_local, mail, mailm, cnt, dropped, rdstl * b + roff, rrows,
         rw, rvalid)
-    return mail, mailm, cnt, dropped, xovf + ovf
+    return mail, mailm, cnt, dropped, exchange.ovf_join(xo + ovf, exch)
 
 
 def make_sharded_pushsum_step(cfg: Config, mesh):
@@ -154,6 +162,7 @@ def make_sharded_pushsum_step(cfg: Config, mesh):
     # buffer uses the event-heal zero-loss-leaning bound (overflow is
     # counted, and the conservation tests assert it stays 0).
     rcap = min(exchange.epidemic_cap(n_local, k, s), n_local * k)
+    spatial = cfg.telemetry_spatial_enabled and s > 1
 
     def step_shard(st: PushSumState, base_key: jax.Array) -> PushSumState:
         shard = jax.lax.axis_index(AXIS)
@@ -196,10 +205,13 @@ def make_sharded_pushsum_step(cfg: Config, mesh):
             pushsum.emit_shares(cfg, m3, crashed, st.friends,
                                 st.friend_cnt, st.tick, gids, base_key)
         ddrop = jnp.zeros((), I32)
+        xv0 = exchange.ovf_join(jnp.zeros((), I32),
+                                st.exch_counts if spatial else None)
         mail, mailm, cnt, ddrop, dxovf = _route_append_mass(
             cfg, s, n_local, st.mail_ids, st.mail_mass, st.mail_cnt,
-            ddrop, jnp.zeros((), I32), dst, wslot, off, lane_valid, rcap,
+            ddrop, xv0, dst, wslot, off, lane_valid, rcap,
             share)
+        dxovf, exch_new = exchange.ovf_split(dxovf)
         cnt = cnt.at[0, slot].set(0)
         dm = lane_valid.sum(dtype=I32)
         if scen.has_faults:
@@ -208,6 +220,8 @@ def make_sharded_pushsum_step(cfg: Config, mesh):
         else:
             dm, ddrop, dxovf, blk = jax.lax.psum(
                 (dm, ddrop, dxovf, blk), AXIS)
+        if exch_new is not None:
+            st = st._replace(exch_counts=exch_new)
         return st._replace(
             flags=flags, down_since=down,
             mass=new_m3.reshape(n_local, C),
@@ -299,7 +313,8 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
         from gossip_simulator_tpu.utils import telemetry as telem
 
         ihwm = exchange.inflight_hwm(cfg, mesh.shape[AXIS])
-        hspecs = telem.History(idx=P(), cols=P(None, None))
+        spatial = telem.spatial_spec(cfg, int(mesh.shape[AXIS]))
+        hspecs = telem.bundle_specs(spatial, P)
 
         @functools.partial(jax.jit, donate_argnums=(0, 4))
         def run_t(st: PushSumState, base_key, target_count, until, hist):
@@ -315,7 +330,12 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
                         s, False, psum=lambda x: jax.lax.psum(x, AXIS),
                         pmax=lambda x: jax.lax.pmax(x, AXIS),
                         inflight_hwm=ihwm, relerr=s.relerr_ppb)
-                    return s, telem.record(h, row)
+                    return s, telem.record_window(
+                        h, row, st=s, spec=spatial,
+                        shard_index=jax.lax.axis_index(AXIS),
+                        gather=lambda x: jax.lax.all_gather(x, AXIS),
+                        psum=lambda x: jax.lax.psum(x, AXIS),
+                        relerr=s.relerr_ppb)
 
                 return jax.lax.while_loop(cond, body, (st, hist))
 

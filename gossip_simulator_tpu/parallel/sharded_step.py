@@ -53,6 +53,9 @@ def sim_state_specs(cfg: Config) -> SimState:
         # replicated placeholders.
         pending_rumors=P(), rumor_words=P(), rumor_recv=P(),
         rumor_done=P(),
+        # Per-shard exchange counters stack to (S, S+2); the 1x1
+        # off-path placeholder splits the same way to (S, 1).
+        exch_counts=P(AXIS, None),
     )
 
 
@@ -81,26 +84,31 @@ def _shard_map(mesh, fn, in_specs, out_specs):
 # --------------------------------------------------------------------------
 
 def _deposit_routed(cfg: Config, n_local: int, n_shards: int, pending,
-                    dst_global, slots, valid, cap: int):
+                    dst_global, slots, valid, cap: int, exch=None):
     """Route (dst, ring-slot) messages to their owning shards and scatter
     into the local pending ring.  Returns (pending, local overflow).
     `cap` is the per-destination-shard buffer size (exchange.epidemic_cap of
-    the wave's row count x row width)."""
+    the wave's row count x row width).  `exch` non-None (the spatial
+    panels' exch_counts leaf) accumulates the route's traffic and a 3rd
+    value returns the updated leaf."""
     d = epidemic.ring_depth(cfg)
     dest_shard = jnp.where(valid, dst_global // n_local, n_shards)
     dst_local = jnp.where(valid, dst_global % n_local, 0)
     packed = jnp.where(valid, exchange.pack_dst_slot(dst_local, slots, d), -1)
-    recv, overflow = exchange.route_one(packed, dest_shard, valid,
-                                        n_shards, cap)
+    out = exchange.route_one(packed, dest_shard, valid, n_shards, cap,
+                             traffic=exch)
+    (recv, overflow), exch = out[:2], out[2] if exch is not None else None
     rvalid = recv >= 0
     rdst, rslot = exchange.unpack_dst_slot(jnp.maximum(recv, 0), d)
     pending = epidemic.deposit_local(pending, rdst, rslot, rvalid,
                                      kernel=cfg.deliver_kernel_resolved)
-    return pending, overflow
+    if exch is None:
+        return pending, overflow
+    return pending, overflow, exch
 
 
 def _route_stage_si(cfg: Config, n_local: int, n_shards: int, dst_global,
-                    slots, valid, cap: int, pstage):
+                    slots, valid, cap: int, pstage, exch=None):
     """Pipelined twin of _deposit_routed's route half (-exchange-pipeline
     double): the same pack/route/unpack, but the deposit arguments come
     back as the next staged drain instead of being scattered -- the
@@ -109,16 +117,21 @@ def _route_stage_si(cfg: Config, n_local: int, n_shards: int, dst_global,
     trivially bit-identical here: nothing in the chunk loop reads
     `pending` (compact_gather keys off friends/dslot/remaining only),
     and deposits replay in the serial FIFO order.  Returns
-    (stage_new, overflow, pstage_threaded)."""
+    (stage_new, overflow, pstage_threaded[, exch])."""
     d = epidemic.ring_depth(cfg)
     dest_shard = jnp.where(valid, dst_global // n_local, n_shards)
     dst_local = jnp.where(valid, dst_global % n_local, 0)
     packed = jnp.where(valid, exchange.pack_dst_slot(dst_local, slots, d), -1)
-    (recv,), overflow, pstage = exchange.route_multi_pipelined(
-        (packed,), dest_shard, valid, n_shards, cap, pstage)
+    out = exchange.route_multi_pipelined(
+        (packed,), dest_shard, valid, n_shards, cap, pstage, traffic=exch)
+    ((recv,), overflow, pstage), exch = out[:3], (out[3]
+                                                  if exch is not None
+                                                  else None)
     rvalid = recv >= 0
     rdst, rslot = exchange.unpack_dst_slot(jnp.maximum(recv, 0), d)
-    return (rdst, rslot, rvalid), overflow, pstage
+    if exch is None:
+        return (rdst, rslot, rvalid), overflow, pstage
+    return (rdst, rslot, rvalid), overflow, pstage, exch
 
 
 def _flush_deposit(cfg: Config, pending, stage):
@@ -146,6 +159,9 @@ def make_sharded_tick(cfg: Config, mesh):
     # all_to_all (see _route_stage_si); the dense path's single route
     # per tick has no loop to pipeline and stays serial.
     pipe = exchange.pipeline_enabled(cfg, s)
+    # Spatial panels: the exch_counts leaf rides the ovf carry position
+    # as a pair (exchange.ovf_split) through the chunk loops.
+    spatial = cfg.telemetry_spatial_enabled and s > 1
 
     def tick_shard(st: SimState, base_key: jax.Array) -> SimState:
         shard = jax.lax.axis_index(AXIS)
@@ -160,6 +176,8 @@ def make_sharded_tick(cfg: Config, mesh):
         stp, senders, dslot, (dm, dr, dc) = epidemic.tick_core(cfg, st, keys)
         width = stp.friends.shape[1]
         zblk = jnp.zeros((), I32)
+        ovf0 = exchange.ovf_join(jnp.zeros((), I32),
+                                 st.exch_counts if spatial else None)
         if cfg.compact_resolved:
             # Compacted wave: only sender rows reach the RNG/sort/all_to_all.
             # Chunk count is agreed across shards (pmax) so every shard
@@ -187,60 +205,81 @@ def make_sharded_tick(cfg: Config, mesh):
                 # stage flushes after the loop.
                 def body_pipe(_, carry):
                     pending, remaining, ovf, blk, pend = carry
+                    oacc, exch = exchange.ovf_split(ovf)
                     (dstg, slots, valid, remaining,
                      b2) = epidemic.compact_gather(
                         cfg, stp.friends, stp.friend_cnt, dslot,
                         keys["delay"], keys["drop"], st.tick, remaining,
                         ccap, **(dict(gid0=gid0) if track_part else {}))
-                    nstage, o, pthr = _route_stage_si(
-                        cfg, n_local, s, dstg, slots, valid, rcap, pend)
+                    out = _route_stage_si(
+                        cfg, n_local, s, dstg, slots, valid, rcap, pend,
+                        exch=exch)
+                    (nstage, o, pthr), exch = out[:3], (
+                        out[3] if exch is not None else None)
                     pending = _flush_deposit(cfg, pending, pthr)
-                    return (pending, remaining, ovf + o,
+                    return (pending, remaining,
+                            exchange.ovf_join(oacc + o, exch),
                             blk + (b2 if track_part else 0), nstage)
 
                 pending, _, ovf, blk, pend = jax.lax.fori_loop(
                     0, chunks, body_pipe,
-                    (stp.pending, senders, jnp.zeros((), I32), zblk,
+                    (stp.pending, senders, ovf0, zblk,
                      _empty_deposit_stage(s * rcap)))
                 pending = _flush_deposit(cfg, pending, pend)
             elif track_part:
                 def body_p(_, carry):
                     pending, remaining, ovf, blk = carry
+                    oacc, exch = exchange.ovf_split(ovf)
                     (dstg, slots, valid, remaining,
                      b2) = epidemic.compact_gather(
                         cfg, stp.friends, stp.friend_cnt, dslot,
                         keys["delay"], keys["drop"], st.tick, remaining,
                         ccap, gid0=gid0)
-                    pending, o = _deposit_routed(cfg, n_local, s, pending,
-                                                 dstg, slots, valid, rcap)
-                    return pending, remaining, ovf + o, blk + b2
+                    out = _deposit_routed(cfg, n_local, s, pending,
+                                          dstg, slots, valid, rcap,
+                                          exch=exch)
+                    (pending, o), exch = out[:2], (
+                        out[2] if exch is not None else None)
+                    return (pending, remaining,
+                            exchange.ovf_join(oacc + o, exch), blk + b2)
 
                 pending, _, ovf, blk = jax.lax.fori_loop(
                     0, chunks, body_p,
-                    (stp.pending, senders, jnp.zeros((), I32), zblk))
+                    (stp.pending, senders, ovf0, zblk))
             else:
                 def body(_, carry):
                     pending, remaining, ovf = carry
+                    oacc, exch = exchange.ovf_split(ovf)
                     (dstg, slots, valid, remaining,
                      _b) = epidemic.compact_gather(
                         cfg, stp.friends, stp.friend_cnt, dslot,
                         keys["delay"], keys["drop"], st.tick, remaining,
                         ccap)
-                    pending, o = _deposit_routed(cfg, n_local, s, pending,
-                                                 dstg, slots, valid, rcap)
-                    return pending, remaining, ovf + o
+                    out = _deposit_routed(cfg, n_local, s, pending,
+                                          dstg, slots, valid, rcap,
+                                          exch=exch)
+                    (pending, o), exch = out[:2], (
+                        out[2] if exch is not None else None)
+                    return (pending, remaining,
+                            exchange.ovf_join(oacc + o, exch))
 
                 pending, _, ovf = jax.lax.fori_loop(
                     0, chunks, body,
-                    (stp.pending, senders, jnp.zeros((), I32)))
+                    (stp.pending, senders, ovf0))
                 blk = zblk
         else:
             dst, slots, valid, blk = epidemic.edges_from_senders(
                 cfg, stp.friends, stp.friend_cnt, senders, dslot,
                 keys["drop"], tick=st.tick, gid0=gid0)
-            pending, ovf = _deposit_routed(
+            exch = st.exch_counts if spatial else None
+            out = _deposit_routed(
                 cfg, n_local, s, stp.pending, dst, slots, valid,
-                exchange.epidemic_cap(n_local, width, s))
+                exchange.epidemic_cap(n_local, width, s), exch=exch)
+            (pending, ovf), exch = out[:2], (
+                out[2] if exch is not None else None)
+            ovf = exchange.ovf_join(ovf, exch)
+        # Traffic rows are per-shard gauges: split them off BEFORE the psum.
+        ovf, exch = exchange.ovf_split(ovf)
         dm, dr, dc, ovf = jax.lax.psum((dm, dr, dc, ovf), AXIS)
         # NOTE: no lax.cond empty-slot skip here -- see the miscompile note
         # in epidemic.make_tick_fn (axon platform, cond + dynamic fori).
@@ -252,6 +291,8 @@ def make_sharded_tick(cfg: Config, mesh):
             total_received=stp.total_received + dr,
             total_crashed=stp.total_crashed + dc,
             exchange_overflow=stp.exchange_overflow + ovf)
+        if exch is not None:
+            stp = stp._replace(exch_counts=exch)
         if cfg.scenario_resolved.active:
             dsc, dsr, blk = jax.lax.psum(
                 (jnp.asarray(dsc, I32), jnp.asarray(dsr, I32),
@@ -274,6 +315,7 @@ def make_sharded_pushpull(cfg: Config, mesh):
     drop_p = epidemic.p_eff(cfg, cfg.droprate)
     crash_p = epidemic.p_eff(cfg, cfg.crashrate)
     cap = exchange.epidemic_cap(n_local, f, s)
+    spatial = cfg.telemetry_spatial_enabled and s > 1
 
     def round_shard(st: SimState, base_key: jax.Array) -> SimState:
         shard = jax.lax.axis_index(AXIS)
@@ -294,9 +336,13 @@ def make_sharded_pushpull(cfg: Config, mesh):
         kept = ~_rng.bernoulli(kd1, drop_p, (n_local, f))
         edge = (inf[:, None] & kept).reshape(-1)
         dstg = peers.reshape(-1)
-        recv, ovf1 = exchange.route_one(
+        exch = st.exch_counts if spatial else None
+        out = exchange.route_one(
             jnp.where(edge, dstg % n_local, -1),
-            jnp.where(edge, dstg // n_local, s), edge, s, cap)
+            jnp.where(edge, dstg // n_local, s), edge, s, cap,
+            traffic=exch)
+        (recv, ovf1), exch = out[:2], (
+            out[2] if exch is not None else None)
         rvalid = recv >= 0
         arriving = jnp.zeros((n_local,), I32).at[
             jnp.where(rvalid, recv, n_local)].add(1, mode="drop")
@@ -318,11 +364,13 @@ def make_sharded_pushpull(cfg: Config, mesh):
         tgt = peers2.reshape(-1)
         dest = jnp.where(req, tgt // n_local, s)
         # Target row and requester id share one sort + one all_to_all.
-        (rtgt, rreq), ovf2 = exchange.route_multi(
+        out = exchange.route_multi(
             (jnp.where(req, tgt % n_local, -1),
              jnp.where(req, jnp.broadcast_to(
                  gids[:, None], (n_local, f)).reshape(-1), -1)),
-            dest, req, s, cap)
+            dest, req, s, cap, traffic=exch)
+        ((rtgt, rreq), ovf2), exch = out[:2], (
+            out[2] if exch is not None else None)
         tvalid = rtgt >= 0
         tgt_idx = jnp.where(tvalid, rtgt, 0)
         # A live peer answers any request (counted); an infected live peer's
@@ -333,9 +381,12 @@ def make_sharded_pushpull(cfg: Config, mesh):
         answered = tvalid & (peer_state < 2)
         dm = dm + answered.sum(dtype=I32)
         hit = answered & (peer_state == 1)
-        back, ovf4 = exchange.route_one(
+        out = exchange.route_one(
             jnp.where(hit, rreq % n_local, -1),
-            jnp.where(hit, rreq // n_local, s), hit, s, cap)
+            jnp.where(hit, rreq // n_local, s), hit, s, cap,
+            traffic=exch)
+        (back, ovf4), exch = out[:2], (
+            out[2] if exch is not None else None)
         bvalid = back >= 0
         pull_hit = jnp.zeros((n_local,), bool).at[
             jnp.where(bvalid, back, n_local)].max(bvalid, mode="drop")
@@ -345,12 +396,15 @@ def make_sharded_pushpull(cfg: Config, mesh):
         dr = newly.sum(dtype=I32)
         dm, dr, dc = jax.lax.psum((dm, dr, dc), AXIS)
         ovf = jax.lax.psum(ovf1 + ovf2 + ovf4, AXIS)
-        return st._replace(
+        stp = st._replace(
             received=received, crashed=crashed, tick=st.tick + 1,
             total_message=msg64_add(st.total_message, dm),
             total_received=st.total_received + dr,
             total_crashed=st.total_crashed + dc,
             exchange_overflow=st.exchange_overflow + ovf)
+        if exch is not None:
+            stp = stp._replace(exch_counts=exch)
+        return stp
 
     return round_shard
 
@@ -378,6 +432,7 @@ def make_sharded_heal(cfg: Config, mesh):
     n_local = shard_size(cfg.n, mesh)
     detect = cfg.heal_detect_ms
     d = epidemic.ring_depth(cfg)
+    spatial = cfg.telemetry_spatial_enabled and s > 1
 
     def heal_shard(st: SimState, base_key: jax.Array) -> SimState:
         shard = jax.lax.axis_index(AXIS)
@@ -401,9 +456,12 @@ def make_sharded_heal(cfg: Config, mesh):
             dslot = ((st.tick + delay) % d).astype(I32)
         dst = jnp.where(resend, friends, -1).reshape(-1)
         slots = jnp.broadcast_to(dslot[:, None], (n_local, k)).reshape(-1)
-        pending, ovf = _deposit_routed(
+        exch = st.exch_counts if spatial else None
+        out = _deposit_routed(
             cfg, n_local, s, st.pending, dst, slots, resend.reshape(-1),
-            exchange.epidemic_cap(n_local, k, s))
+            exchange.epidemic_cap(n_local, k, s), exch=exch)
+        (pending, ovf), exch = out[:2], (
+            out[2] if exch is not None else None)
         # Rejoin pull responses deliver to the puller's OWN row -- always
         # shard-local, so they skip the route.
         pdst = jnp.broadcast_to(rows[:, None], (n_local, k)).reshape(-1)
@@ -412,12 +470,15 @@ def make_sharded_heal(cfg: Config, mesh):
                                          kernel=cfg.deliver_kernel_resolved)
         rep, blk, ovf = jax.lax.psum(
             (rep, jnp.asarray(blk, I32), ovf), AXIS)
-        return st._replace(
+        stp = st._replace(
             friends=friends, pending=pending,
             down_since=jnp.where(clear, -1, st.down_since),
             heal_repaired=st.heal_repaired + rep,
             part_dropped=st.part_dropped + blk,
             exchange_overflow=st.exchange_overflow + ovf)
+        if exch is not None:
+            stp = stp._replace(exch_counts=exch)
+        return stp
 
     return heal_shard
 
@@ -426,6 +487,7 @@ def make_sharded_seed(cfg: Config, mesh):
     """Uniform-random global sender; its broadcast is routed like any wave."""
     s = mesh.shape[AXIS]
     n_local = shard_size(cfg.n, mesh)
+    spatial = cfg.telemetry_spatial_enabled and s > 1
 
     def seed_shard(st: SimState, base_key: jax.Array) -> SimState:
         shard = jax.lax.axis_index(AXIS)
@@ -451,9 +513,13 @@ def make_sharded_seed(cfg: Config, mesh):
         if cfg.scenario_resolved.has_partitions:
             st = st._replace(part_dropped=st.part_dropped
                              + jax.lax.psum(blk, AXIS))
-        pending, ovf = _deposit_routed(
+        exch = st.exch_counts if spatial else None
+        out = _deposit_routed(
             cfg, n_local, s, st.pending, dst, slots, valid,
-            exchange.epidemic_cap(n_local, st.friends.shape[1], s))
+            exchange.epidemic_cap(n_local, st.friends.shape[1], s),
+            exch=exch)
+        (pending, ovf), exch = out[:2], (
+            out[2] if exch is not None else None)
         rb = st.rebroadcast
         if cfg.protocol == "sir":
             kr = _rng.tick_key(base_key, epidemic.SEED_TICK, _rng.OP_REMOVE)
@@ -462,9 +528,12 @@ def make_sharded_seed(cfg: Config, mesh):
             rb = rb.at[dslot, jnp.arange(n_local, dtype=I32)].max(
                 is_sender & keep)
         ovf = jax.lax.psum(ovf, AXIS)
-        return st._replace(received=received, total_received=total_received,
-                           pending=pending, rebroadcast=rb,
-                           exchange_overflow=st.exchange_overflow + ovf)
+        stp = st._replace(received=received, total_received=total_received,
+                          pending=pending, rebroadcast=rb,
+                          exchange_overflow=st.exchange_overflow + ovf)
+        if exch is not None:
+            stp = stp._replace(exch_counts=exch)
+        return stp
 
     return seed_shard
 
@@ -474,13 +543,15 @@ def make_sharded_init(cfg: Config, mesh):
     (each shard generates its own row slice; the row-keyed generators make
     this bit-identical to slicing a single-device generation)."""
     n_local = shard_size(cfg.n, mesh)
+    n_shards = mesh.shape[AXIS]
 
     def init_shard():
         shard = jax.lax.axis_index(AXIS)
         key = graphs.graph_key(cfg)
         friends, cnt = graphs.generate(cfg, key, row0=shard * n_local,
                                        rows=n_local)
-        return epidemic.init_state(cfg, friends, cnt, n_local=n_local)
+        return epidemic.init_state(cfg, friends, cnt, n_local=n_local,
+                                   n_shards=n_shards)
 
     specs = sim_state_specs(cfg)
     fn = _shard_map(mesh, init_shard, in_specs=(), out_specs=specs)
@@ -616,7 +687,8 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
 
         sir = cfg.protocol == "sir"
         ihwm = exchange.inflight_hwm(cfg, mesh.shape[AXIS])
-        hspecs = telem.History(idx=P(), cols=P(None, None))
+        spatial = telem.spatial_spec(cfg, int(mesh.shape[AXIS]))
+        hspecs = telem.bundle_specs(spatial, P)
 
         @functools.partial(jax.jit, donate_argnums=(0, 4))
         def run_t(st: SimState, base_key, target_count, until, hist):
@@ -632,7 +704,11 @@ def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):
                         s, sir, psum=lambda x: jax.lax.psum(x, AXIS),
                         pmax=lambda x: jax.lax.pmax(x, AXIS),
                         inflight_hwm=ihwm)
-                    return s, telem.record(h, row)
+                    return s, telem.record_window(
+                        h, row, st=s, spec=spatial,
+                        shard_index=jax.lax.axis_index(AXIS),
+                        gather=lambda x: jax.lax.all_gather(x, AXIS),
+                        psum=lambda x: jax.lax.psum(x, AXIS))
 
                 return jax.lax.while_loop(cond, body, (st, hist))
 

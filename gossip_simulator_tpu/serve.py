@@ -126,6 +126,29 @@ def _occupancy(stepper, cfg: Config, n_shards: int) -> float:
     return float(jax.device_get(jnp.max(cnt))) / float(max(cap, 1))
 
 
+def _fmt_occ(vec: list) -> str:
+    """Compact per-shard occupancy rendering for transcript notes:
+    `[0.12 0.31 ...]` (two decimals -- the note is a trend readout, the
+    decision-log entry keeps the precise values)."""
+    return "[" + " ".join(f"{v:.2f}" for v in vec) + "]"
+
+
+def _occupancy_vector(stepper, cfg: Config, n_shards: int) -> list:
+    """Per-shard occupancy fractions -- the spatial shard panel's live
+    analog (serve runs with telemetry off, so the decision log reads the
+    ring directly).  mail_cnt is (1, dw) per shard, (S, dw) gathered;
+    each shard's fullest window slot over the per-shard capacity."""
+    state = getattr(stepper, "state", None)
+    cnt = getattr(state, "mail_cnt", None)
+    if cnt is None:
+        return []
+    from gossip_simulator_tpu.models.event import slot_cap
+
+    cap = float(max(slot_cap(cfg, max(cfg.n // n_shards, 1)), 1))
+    arr = np.asarray(jax.device_get(cnt)).reshape(n_shards, -1)
+    return [round(float(v) / cap, 4) for v in arr.max(axis=1)]
+
+
 def _pending_mask(cfg: Config, current_tick: int) -> np.ndarray:
     from gossip_simulator_tpu import arrivals as _arrivals
 
@@ -258,6 +281,15 @@ def run_serve(cfg: Config, stepper: Stepper, printer: ProgressPrinter,
 
         # --- autoscaler ---------------------------------------------------
         occ = _occupancy(stepper, live_cfg, s)
+        occ_v = _occupancy_vector(stepper, live_cfg, s)
+        # Shard-health feed (utils/health.py's stuck-at-cap predicate,
+        # live): any shard at/over its slot capacity gets flagged in the
+        # decision log and the flight recorder before loss shows up in
+        # mailbox_dropped.
+        at_cap = [i for i, v in enumerate(occ_v) if v >= 1.0]
+        if at_cap:
+            _trace.instant("health.occupancy_at_cap", cat="health",
+                           shards=at_cap)
         if occ < cfg.serve_high:
             backoff_ms = 0
         target_s: Optional[int] = None
@@ -294,6 +326,8 @@ def run_serve(cfg: Config, stepper: Stepper, printer: ProgressPrinter,
                         entry = {"window": windows, "tick": stats.round,
                                  "action": "defer", "from": s, "to": s,
                                  "occupancy": round(occ, 4),
+                                 "occupancy_shards": occ_v,
+                                 "shards_at_cap": at_cap,
                                  "deferred": deferred,
                                  "backoff_ms": backoff_ms,
                                  "pause_ms": round(pause, 3)}
@@ -302,7 +336,8 @@ def run_serve(cfg: Config, stepper: Stepper, printer: ProgressPrinter,
                         printer.note(
                             f"serve: deferred {deferred} pending "
                             f"injections by {backoff_ms}ms (occupancy "
-                            f"{occ:.2f} at widest mesh S={s})")
+                            f"{occ:.2f} at widest mesh S={s}, per-shard "
+                            f"{_fmt_occ(occ_v)})")
             elif lo_run >= cfg.serve_window:
                 lo_run = 0
                 down = next_shard_count(s, -1, min_s, max_s, cfg.n)
@@ -316,12 +351,15 @@ def run_serve(cfg: Config, stepper: Stepper, printer: ProgressPrinter,
             entry = {"window": windows, "tick": stats.round,
                      "action": action, "from": s, "to": target_s,
                      "occupancy": round(occ, 4),
+                     "occupancy_shards": occ_v,
+                     "shards_at_cap": at_cap,
                      "pause_ms": round(pause, 3)}
             decisions.append(entry)
             _trace.instant("serve.decision", **entry)
             printer.note(
                 f"serve: {action} S={s}->{target_s} at window {windows} "
-                f"(occupancy {occ:.2f}, pause {pause:.0f}ms)")
+                f"(occupancy {occ:.2f}, per-shard {_fmt_occ(occ_v)}, "
+                f"pause {pause:.0f}ms)")
             s = target_s
             seg_start_tick = stats.round
             seg_start_msg = stats.total_message

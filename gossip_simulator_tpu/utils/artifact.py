@@ -12,6 +12,8 @@ run without re-parsing argv or git-stashing twins:
       metrics.jsonl   the structured JSONL log (schema v3, header first)
       telemetry.npz   fetched per-window histories + canonical trajectory
       trace.json      Chrome trace-event spans (when tracing is on)
+      health.json     shard-health watchdog verdict over the spatial
+                      panels (utils/health.py; spatial runs only)
       result.json     final Stats / RunResult payload + the trajectory
                       fingerprint
 
@@ -168,6 +170,16 @@ class RunDir:
             arrays["gossip_cols"] = gossip["cols"][:gossip["count"]]
             arrays["gossip_count"] = np.int64(gossip["count"])
             arrays["gossip_names"] = np.array(telemetry.GOSSIP_COLS)
+            if "spatial_group" in gossip:
+                # Spatial panels (telemetry tentpole): already trimmed to
+                # the recorded window count by fetch_history.
+                arrays["spatial_group"] = gossip["spatial_group"]
+                arrays["spatial_group_names"] = np.array(
+                    telemetry.SPATIAL_GROUP_COLS)
+                arrays["spatial_shard"] = gossip["spatial_shard"]
+                arrays["spatial_shard_names"] = np.array(
+                    telemetry.SPATIAL_SHARD_COLS)
+                arrays["spatial_traffic"] = gossip["spatial_traffic"]
         if overlay is not None:
             arrays["overlay_cols"] = overlay["cols"][:overlay["count"]]
             arrays["overlay_count"] = np.int64(overlay["count"])
@@ -191,6 +203,11 @@ class RunDir:
         """Serve-mode sidecar: the autoscaler decision log, per-reshard
         pause spans and SLO summary (gossip_simulator_tpu/serve.py)."""
         return self._write_json("serve.json", doc)
+
+    def write_health(self, verdict: dict) -> str:
+        """Shard-health watchdog verdict (utils/health.py) over the
+        spatial panels: status + the findings that fired."""
+        return self._write_json("health.json", verdict)
 
 
 def load_run(path: str) -> dict:

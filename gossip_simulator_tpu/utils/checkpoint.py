@@ -372,6 +372,14 @@ def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
               "heal_repaired"):
         if f not in tree:
             tree[f] = np.zeros((), np.int32)
+    # Spatial-telemetry exchange counters (models/state.init_exch_counts):
+    # per-shard diagnostic gauges, not trajectory state.  Their width
+    # depends on the RESTORING run's shard count and -telemetry-spatial
+    # flag, so rebuild them at zero rather than coercing the snapshot's
+    # (a resumed run's traffic matrix restarts at the resume window).
+    w = (n_shards + 2
+         if (cfg.telemetry_spatial_enabled and n_shards > 1) else 1)
+    tree["exch_counts"] = np.zeros((n_shards, w), np.int32)
     return tree
 
 

@@ -1,0 +1,163 @@
+"""Shard-health watchdog: declared predicates over the spatial panels.
+
+The spatial telemetry tentpole (utils/telemetry.py: group/shard panels +
+exchange traffic matrix) records WHERE a run's counters move; this module
+is the host-side consumer that turns the fetched panels into a verdict.
+Every check is a pure function of the snapshot dict `fetch_history`
+returns -- no device access, no config plumbing beyond the optional ring
+capacity -- so the same evaluation runs after a simulation (driver writes
+`health.json` into the run dir), inside the serve loop (the autoscaler's
+decision log carries the findings), and in tests against hand-built
+panels.
+
+Checks (each produces zero or more findings):
+
+- ``occupancy_stuck_at_cap``: a shard's mail-ring occupancy high-water
+  sat AT the slot capacity for the last K windows.  A full ring means
+  the drain is not keeping up with arrivals on that shard -- the
+  precursor of `mailbox_dropped` growth.  Needs `cap` (the event/pushsum
+  engines' slot capacity); skipped when None (the ring engine's pending
+  max is an arrival count with no hard cap).
+- ``zero_delivery_shard``: a shard received NO routed lanes over the
+  last K windows while its siblings did.  On a healthy mesh the routed
+  all_to_all spreads every window's emissions across all shards; one
+  silent column of the traffic matrix is a partitioned / wedged shard.
+- ``group_coverage_stall``: a group's received gauge stopped growing for
+  K windows below saturation while some sibling group still grew -- the
+  spatial signature of a crash wave or partition confining the rumor.
+
+The verdict is ``degraded`` when any finding fired, else ``ok`` (or
+``no-data`` without panels -- spatial off, or a run too short to judge).
+Findings also go to the flight recorder as instant events
+(utils/trace.py `instant`, strict no-op without `-trace`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from gossip_simulator_tpu.utils import trace as _trace
+
+# Minimum trailing windows a stall/stuck predicate needs before it may
+# fire -- a 2-window run has no trend to judge.
+STALL_WINDOWS = 3
+
+
+def _panel_cols(gossip: dict):
+    from gossip_simulator_tpu.utils.telemetry import (SPATIAL_GROUP_COLS,
+                                                      SPATIAL_SHARD_COLS)
+
+    return (SPATIAL_GROUP_COLS.index("received"),
+            SPATIAL_SHARD_COLS.index("mail_high"),
+            SPATIAL_SHARD_COLS.index("exch_rcvd"))
+
+
+def evaluate_health(gossip: Optional[dict], cap: Optional[int] = None,
+                    stall_windows: int = STALL_WINDOWS) -> dict:
+    """Evaluate every predicate against one fetched gossip snapshot.
+
+    `gossip` is `TelemetrySession.gossip_snapshot()` output (None or a
+    dict without `spatial_group` yields the ``no-data`` verdict).  `cap`
+    is the per-(window, node) slot capacity the occupancy column is
+    measured against, when the engine has one.  Returns::
+
+        {"status": "ok" | "degraded" | "no-data",
+         "windows": <evaluated window count>,
+         "checks": [<names run>],
+         "findings": [{"check", "subject", "index", "windows", "detail"},
+                      ...]}
+    """
+    if not gossip or "spatial_group" not in gossip:
+        return {"status": "no-data", "windows": 0, "checks": [],
+                "findings": []}
+    i_recv, i_high, i_rcvd = _panel_cols(gossip)
+    group = np.asarray(gossip["spatial_group"])
+    shard = np.asarray(gossip["spatial_shard"])
+    w = int(group.shape[0])
+    k = min(int(stall_windows), w)
+    findings: list[dict] = []
+    checks: list[str] = []
+
+    # --- occupancy stuck at cap (per shard, trailing K windows) ----------
+    if cap is not None and w >= stall_windows:
+        checks.append("occupancy_stuck_at_cap")
+        tail = shard[w - k:, :, i_high]
+        for s in np.flatnonzero((tail >= int(cap)).all(axis=0)):
+            findings.append({
+                "check": "occupancy_stuck_at_cap", "subject": "shard",
+                "index": int(s), "windows": k,
+                "detail": f"mail-ring high-water pinned at cap {int(cap)} "
+                          f"for the last {k} windows"})
+
+    # --- zero-delivery shard (cumulative exch_rcvd deltas) ---------------
+    n_shards = int(shard.shape[1])
+    if n_shards > 1 and w > stall_windows:
+        checks.append("zero_delivery_shard")
+        rcvd = shard[:, :, i_rcvd]
+        delta = rcvd[w - 1] - rcvd[w - 1 - k]
+        if (delta > 0).any():
+            for s in np.flatnonzero(delta == 0):
+                findings.append({
+                    "check": "zero_delivery_shard", "subject": "shard",
+                    "index": int(s), "windows": k,
+                    "detail": f"no routed lanes delivered in the last {k} "
+                              "windows while sibling shards kept "
+                              "receiving"})
+
+    # --- group coverage stall (received gauge, vs siblings) --------------
+    if w > stall_windows:
+        checks.append("group_coverage_stall")
+        recv = group[:, :, i_recv]
+        delta = recv[w - 1] - recv[w - 1 - k]
+        # Saturation guard: a group that already reached its high-water
+        # (its receive gauge equals the run's max for that group) is
+        # done, not stalled.  Down nodes lower the gauge, so compare
+        # against the group's own historical peak.
+        peak = recv.max(axis=0)
+        stalled = (delta == 0) & (recv[w - 1] < peak) | \
+                  ((delta == 0) & (recv[w - 1] == 0))
+        if (delta > 0).any():
+            for g in np.flatnonzero(stalled):
+                findings.append({
+                    "check": "group_coverage_stall", "subject": "group",
+                    "index": int(g), "windows": k,
+                    "detail": f"received gauge flat for the last {k} "
+                              "windows below its peak while sibling "
+                              "groups kept growing"})
+
+    status = "degraded" if findings else "ok"
+    return {"status": status, "windows": w, "checks": checks,
+            "findings": findings}
+
+
+def report_health(verdict: dict) -> dict:
+    """Emit one flight-recorder instant per finding plus the verdict
+    (no-ops without an active tracer) and return the verdict unchanged,
+    so call sites can chain `report_health(evaluate_health(...))`."""
+    for f in verdict.get("findings", ()):
+        _trace.instant(f"health.{f['check']}", cat="health",
+                       subject=f["subject"], index=f["index"],
+                       detail=f["detail"])
+    if verdict.get("status") != "no-data":
+        _trace.instant("health.verdict", cat="health",
+                       status=verdict["status"],
+                       findings=len(verdict.get("findings", ())))
+    return verdict
+
+
+def ring_slot_cap(cfg, n_shards: int = 1) -> Optional[int]:
+    """The occupancy cap the stuck-at-cap check measures against: the
+    mail-ring engines' PER-SHARD per-window slot capacity (the shard
+    panel's mail_high column is each shard's local `mail_cnt` max).
+    None for the ring engine (its pending max is an arrival count with
+    no hard cap), matching the check's skip."""
+    if cfg.model == "pushsum":
+        from gossip_simulator_tpu.models import pushsum as geo
+    elif cfg.engine_resolved == "event":
+        from gossip_simulator_tpu.models import event as geo
+    else:
+        return None
+    n_local = cfg.n // max(1, int(n_shards))
+    return int(geo.slot_cap(cfg, n_local))

@@ -31,22 +31,29 @@ from typing import Optional
 # `event="header"` record naming the telemetry history columns (the named
 # schema replacing positional "14th column" indexing) and adds
 # `run_dir` + the resolved gate set to the terminal `result` record.
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 
 def header_record() -> dict:
-    """The v3 stream header: the column schemas every downstream consumer
+    """The v4 stream header: the column schemas every downstream consumer
     needs to read telemetry histories / npz artifacts without hard-coding
     positions.  Deterministic (no wall clock beyond the stamp `_record`
-    adds), so twin streams stay comparable."""
+    adds), so twin streams stay comparable.  v4 adds the spatial-panel
+    registries (group/shard column names) -- STATIC, not gated on
+    -telemetry-spatial, so a spatial-on twin's JSONL stays byte-identical
+    to its spatial-off twin."""
     from gossip_simulator_tpu.utils.artifact import TRAJECTORY_COLS
     from gossip_simulator_tpu.utils.telemetry import (GOSSIP_COLS,
-                                                      OVERLAY_COLS)
+                                                      OVERLAY_COLS,
+                                                      SPATIAL_GROUP_COLS,
+                                                      SPATIAL_SHARD_COLS)
 
     return {"event": "header",
             "columns": {"gossip": list(GOSSIP_COLS),
                         "overlay": list(OVERLAY_COLS),
-                        "trajectory": list(TRAJECTORY_COLS)}}
+                        "trajectory": list(TRAJECTORY_COLS),
+                        "spatial_group": list(SPATIAL_GROUP_COLS),
+                        "spatial_shard": list(SPATIAL_SHARD_COLS)}}
 
 
 @dataclasses.dataclass

@@ -44,6 +44,62 @@ GOSSIP_COLS = ("tick", "received", "msg_hi", "msg_lo", "crashed", "removed",
                "exchange_inflight_hwm", "relerr_ppb")
 OVERLAY_COLS = ("clock", "makeups", "breakups", "dropped")
 
+# Optional trailing per-window blocks of the JSONL `telemetry` record: a
+# group is emitted only when the build carries its source columns AND any
+# of them is nonzero (all-zero columns would bloat every record), and it
+# is emitted whole -- the scenario quartet travels together.  Each entry
+# maps the emitted per_window key to its GOSSIP_COLS source column; this
+# registry IS the contract scripts/check_telemetry.py validates the
+# stream against (hardcoded per-column name checks drifted once per
+# added column).
+OPTIONAL_BLOCK_GROUPS = (
+    (("scen_crashed", "scen_crashed"), ("scen_recovered", "recovered"),
+     ("heal_repaired", "repaired"), ("part_dropped", "part_dropped")),
+    (("rumors_done", "rumors_done"),),
+    (("exchange_inflight_hwm", "exchange_inflight_hwm"),),
+    (("relerr_ppb", "relerr_ppb"),),
+)
+
+# --- spatial panels (ISSUE 16) ----------------------------------------------
+# Per-window spatial panels recorded next to the scalar history and
+# fetched in the SAME single transfer: a (windows, groups, KG) group
+# panel over the PR-4 scenario contiguous-id ranges (falling back to the
+# sharded backend's shard slices when no scenario declares groups), a
+# (windows, shards, KS) shard panel, and a (windows, S, S) exchange
+# traffic matrix counted inside the routed all_to_all (parallel/
+# exchange.py).  npz-only: the replayed stdout/JSONL surface never reads
+# them, so a spatial-on/off twin pair stays byte-identical.
+#
+# Group columns are probe-time gauges over per-node state, chosen so the
+# reconciliation invariant is exact (tests/test_spatial.py): summed over
+# groups, `received` equals the global received column every window and
+# `removed` equals the removed column; `down` is the currently-crashed
+# count (== scen_crashed when faults are scenario waves without
+# recovery; a cumulative-crash panel would need per-group accumulators
+# in every fault site).
+SPATIAL_GROUP_COLS = ("received", "down", "removed")
+# Shard columns: probe-time mail-ring occupancy high-water (max over
+# shards == the global mail_high column), resident informed count (sums
+# to the received column), and the exchange counters accumulated inside
+# the routed collective (exch_counts layout below) -- send-side overflow
+# and valid lanes received off the wire.  relerr_ppb is the pushsum
+# eps-check error, pmax-replicated by the sharded step (every shard
+# records the same value; per-shard attribution would need the step to
+# defer its pmax).
+SPATIAL_SHARD_COLS = ("mail_high", "received", "overflow", "relerr_ppb",
+                      "exch_rcvd")
+
+# Layout of the per-shard exchange accumulator state leaf (`exch_counts`,
+# int32[1, S + 2] on spatial sharded runs, int32[1, 1] placeholder
+# otherwise -- the down_since convention): [0, :S] is this shard's
+# traffic-matrix row (routed lanes by destination, counted at dispatch
+# inside exchange.route_*), [0, S] the valid lanes received off the
+# wire, [0, S + 1] the send-side overflow (lanes ranked past the slot
+# cap, which never reach a receiver).
+def exch_counts_width(spec) -> int:
+    return spec.n_shards + 2 if (spec is not None and spec.n_shards > 1) \
+        else 1
+
 # Named column indices -- THE way to address a history column (schema v3
 # names these in the JSONL header).  Positional literals ("the 14th
 # column") drifted once per added column; every reader below and every
@@ -77,6 +133,159 @@ def record(hist: History, row) -> History:
     vals = jnp.stack([jnp.asarray(v).astype(jnp.int32) for v in row])
     i = jnp.minimum(hist.idx, cap - 1)
     return History(idx=hist.idx + 1, cols=hist.cols.at[i].set(vals))
+
+
+class Panels(NamedTuple):
+    """Device-resident spatial panels, same ring discipline as History
+    (the bundle shares History.idx -- panels and scalars are always
+    row-aligned)."""
+
+    group: object  # int32[cap, G, KG]
+    shard: object  # int32[cap, S, KS]
+    traffic: object  # int32[cap, S, S]  cumulative routed-lane counts
+
+
+class SpatialBundle(NamedTuple):
+    """The telemetry carry on spatial runs: the scalar History plus the
+    panels.  Threaded through the same `hist` argument of the six
+    run-to-coverage fns (backends/base.py treats it opaquely); a
+    spatial-off run carries a plain History, so the off path traces the
+    pre-spatial program."""
+
+    hist: History
+    panels: Panels
+
+
+class SpatialSpec(NamedTuple):
+    """Static panel geometry, hashable (closed over at trace time).
+    groups = scenario groups when a scenario declares > 1, else the
+    shard count (shard slices ARE contiguous-id groups -- scenario.py's
+    group ranges coincide with the sharded backend's slices when groups
+    == device count); group_size is the ceil-division id-range width."""
+
+    groups: int
+    group_size: int
+    n: int
+    n_shards: int
+
+
+def spatial_spec(cfg, n_shards: int = 1):
+    """The engine- and session-side gate: None when spatial panels are
+    off (the run fns then trace the exact pre-spatial program)."""
+    if not cfg.telemetry_spatial_enabled:
+        return None
+    scen = cfg.scenario_resolved
+    g = scen.groups if (scen.active and scen.groups > 1) \
+        else max(1, int(n_shards))
+    return SpatialSpec(groups=g, group_size=-(-cfg.n // g), n=cfg.n,
+                       n_shards=max(1, int(n_shards)))
+
+
+def empty_panels(cap: int, spec: SpatialSpec) -> Panels:
+    import jax.numpy as jnp
+
+    cap = max(int(cap), 1)
+    g, s = spec.groups, spec.n_shards
+    return Panels(
+        group=jnp.zeros((cap, g, len(SPATIAL_GROUP_COLS)), jnp.int32),
+        shard=jnp.zeros((cap, s, len(SPATIAL_SHARD_COLS)), jnp.int32),
+        traffic=jnp.zeros((cap, s, s), jnp.int32))
+
+
+def bundle_specs(spec, P):
+    """shard_map in/out specs for the telemetry carry: replicated
+    History when spatial is off, replicated bundle when on (every panel
+    row is psum/all_gather-replicated before the scatter)."""
+    hspecs = History(idx=P(), cols=P(None, None))
+    if spec is None:
+        return hspecs
+    return SpatialBundle(hist=hspecs,
+                         panels=Panels(group=P(None, None, None),
+                                       shard=P(None, None, None),
+                                       traffic=P(None, None, None)))
+
+
+def spatial_probe(st, spec: SpatialSpec, shard_index=0, gather=None,
+                  psum=None, relerr=None):
+    """One panel row triple (group (G, KG), shard (S, KS), traffic
+    (S, S)) from an engine's local state view.  Duck-typed like
+    gossip_probe: event/pushsum states carry `flags` + `mail_cnt`, the
+    ring engine boolean node arrays + `pending`.  On sharded engines
+    `shard_index` is lax.axis_index, `gather` all-gathers over the mesh
+    axis and `psum` sums the per-shard group partials; single-device
+    callers leave them None (S == 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    I32 = jnp.int32
+    z = jnp.zeros((), I32)
+    if hasattr(st, "flags"):
+        from gossip_simulator_tpu.models.event import (CRASHED, RECEIVED,
+                                                       REMOVED)
+
+        received = (st.flags & RECEIVED) > 0
+        down = (st.flags & CRASHED) > 0
+        removed = (st.flags & REMOVED) > 0
+        high = st.mail_cnt.max().astype(I32)
+    else:
+        received, down, removed = st.received, st.crashed, st.removed
+        high = st.pending.max().astype(I32)
+    n_local = received.shape[0]
+    vals = jnp.stack([received, down, removed], axis=1).astype(I32)
+    # Per-group sums WITHOUT a length-n scatter (segment_sum lowers to a
+    # serial scatter-add on CPU -- measured ~200ms/window at 1M, blowing
+    # the <=5% overhead budget).  Groups are contiguous equal-width id
+    # ranges, so shift the local block to its within-group offset inside
+    # a chunk-aligned buffer and reduce with a reshape -- the only
+    # scatters left are two O(groups) dynamic_update_slices.
+    gsz = spec.group_size
+    kg = vals.shape[1]
+    n_chunks = -(-n_local // gsz) + 1
+    first = jnp.asarray(shard_index, I32) * n_local
+    buf = jax.lax.dynamic_update_slice(
+        jnp.zeros((n_chunks * gsz, kg), I32), vals, (first % gsz, 0))
+    chunk = buf.reshape(n_chunks, gsz, kg).sum(axis=1, dtype=I32)
+    group_rows = jax.lax.dynamic_update_slice(
+        jnp.zeros((spec.groups + n_chunks, kg), I32), chunk,
+        (first // gsz, 0))[:spec.groups]
+    if psum is not None:
+        group_rows = psum(group_rows)
+    received_loc = vals[:, 0].sum(dtype=I32)
+    rel = jnp.asarray(relerr, I32) if relerr is not None else z
+    s = spec.n_shards
+    if s > 1:
+        ex = st.exch_counts[0]
+        srow = jnp.stack([high, received_loc, ex[s + 1], rel, ex[s]])
+        return group_rows, gather(srow), gather(ex[:s])
+    srow = jnp.stack([high, received_loc, z, rel, z])
+    return group_rows, srow[None, :], jnp.zeros((1, 1), I32)
+
+
+def record_spatial(b: SpatialBundle, row, group_rows, shard_rows,
+                   traffic) -> SpatialBundle:
+    """Append one window's scalar row + panel rows at the shared index."""
+    import jax.numpy as jnp
+
+    cap = b.hist.cols.shape[0]
+    i = jnp.minimum(b.hist.idx, cap - 1)
+    return SpatialBundle(
+        hist=record(b.hist, row),
+        panels=Panels(group=b.panels.group.at[i].set(group_rows),
+                      shard=b.panels.shard.at[i].set(shard_rows),
+                      traffic=b.panels.traffic.at[i].set(traffic)))
+
+
+def record_window(hist, row, st=None, spec=None, shard_index=0,
+                  gather=None, psum=None, relerr=None):
+    """THE per-window recording entry for the six run-to-coverage fns:
+    a plain History append when spatial is off (spec None -- byte-
+    identical trace to the pre-spatial build), the bundle append with a
+    spatial probe when on."""
+    if spec is None:
+        return record(hist, row)
+    g, s, t = spatial_probe(st, spec, shard_index=shard_index,
+                            gather=gather, psum=psum, relerr=relerr)
+    return record_spatial(hist, row, g, s, t)
 
 
 def gossip_probe(st, sir: bool, psum=None, pmax=None, rumors: int = 0,
@@ -143,17 +352,32 @@ def gossip_history_cap(cfg) -> int:
     return max(1, -(-cfg.max_rounds // window) + 2)
 
 
-def fetch_history(hist: Optional[History]) -> Optional[dict]:
-    """ONE device->host transfer of a whole history buffer."""
+def fetch_history(hist) -> Optional[dict]:
+    """ONE device->host transfer of a whole history buffer.  A spatial
+    bundle rides the same single device_get: the snapshot dict gains
+    `spatial_group` / `spatial_shard` / `spatial_traffic` arrays trimmed
+    to the recorded window count."""
     if hist is None:
         return None
     import jax
 
-    idx, cols = jax.device_get((hist.idx, hist.cols))
+    if isinstance(hist, SpatialBundle):
+        idx, cols, pg, ps, pt = jax.device_get(
+            (hist.hist.idx, hist.hist.cols, hist.panels.group,
+             hist.panels.shard, hist.panels.traffic))
+    else:
+        idx, cols = jax.device_get((hist.idx, hist.cols))
+        pg = ps = pt = None
     recorded = int(idx)
     cols = np.asarray(cols)
-    return {"count": min(recorded, cols.shape[0]), "recorded": recorded,
-            "truncated": recorded > cols.shape[0], "cols": cols}
+    out = {"count": min(recorded, cols.shape[0]), "recorded": recorded,
+           "truncated": recorded > cols.shape[0], "cols": cols}
+    if pg is not None:
+        count = out["count"]
+        out["spatial_group"] = np.asarray(pg)[:count]
+        out["spatial_shard"] = np.asarray(ps)[:count]
+        out["spatial_traffic"] = np.asarray(pt)[:count]
+    return out
 
 
 def host_history(rows: list) -> Optional[dict]:
@@ -213,8 +437,9 @@ class TelemetrySession:
     phase is tallied as `compile_s` (tracing + XLA compile dominate it;
     subsequent calls reuse the executable), the rest as `execute_s`."""
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, n_shards: int = 1):
         self.cfg = cfg
+        self.n_shards = n_shards  # panel geometry on spatial runs
         self.phases: dict[str, float] = {}
         self._gossip: Optional[History] = None
         self._overlay: Optional[History] = None
@@ -239,13 +464,16 @@ class TelemetrySession:
         self._overlay_calls += 1
 
     # --- phase-2 history ------------------------------------------------
-    def begin_gossip(self) -> History:
+    def begin_gossip(self):
         if self._gossip is None:
-            self._gossip = empty_history(gossip_history_cap(self.cfg),
-                                         len(GOSSIP_COLS))
+            cap = gossip_history_cap(self.cfg)
+            hist = empty_history(cap, len(GOSSIP_COLS))
+            spec = spatial_spec(self.cfg, self.n_shards)
+            self._gossip = hist if spec is None else \
+                SpatialBundle(hist=hist, panels=empty_panels(cap, spec))
         return self._gossip
 
-    def end_gossip(self, hist: History) -> None:
+    def end_gossip(self, hist) -> None:
         self._gossip = hist
 
     def reset_gossip(self) -> None:
@@ -341,31 +569,19 @@ class TelemetryReport:
                     "dropped": col("dropped").tolist(),
                     "overflow": col("overflow").tolist(),
                 }
-                scen = ("scen_crashed", "recovered", "repaired",
-                        "part_dropped")
-                have = cols.shape[1] > max(GCOL[s] for s in scen)
-                if have and bool(np.stack([col(s) for s in scen]).any()):
-                    # Scenario columns only when a scenario actually ran
-                    # (all-zero columns would bloat every record).
-                    per["scen_crashed"] = col("scen_crashed").tolist()
-                    per["scen_recovered"] = col("recovered").tolist()
-                    per["heal_repaired"] = col("repaired").tolist()
-                    per["part_dropped"] = col("part_dropped").tolist()
-                if (cols.shape[1] > GCOL["rumors_done"]
-                        and bool(col("rumors_done").any())):
-                    # Multi-rumor column only when rumors completed.
-                    per["rumors_done"] = col("rumors_done").tolist()
-                if (cols.shape[1] > GCOL["exchange_inflight_hwm"]
-                        and bool(col("exchange_inflight_hwm").any())):
-                    # Exchange-pipeline depth column only when a routed
-                    # exchange ran (single-device builds record 0).
-                    per["exchange_inflight_hwm"] = \
-                        col("exchange_inflight_hwm").tolist()
-                if (cols.shape[1] > GCOL["relerr_ppb"]
-                        and bool(col("relerr_ppb").any())):
-                    # Numeric-gossip error column only on pushsum runs
-                    # (epidemic models record 0).
-                    per["relerr_ppb"] = col("relerr_ppb").tolist()
+                # Optional trailing blocks, registry-driven (scenario
+                # quartet only when a scenario ran, rumors_done only when
+                # rumors completed, inflight depth only when a routed
+                # exchange ran, relerr only on pushsum runs): a group is
+                # emitted whole when the build carries its columns and
+                # any is nonzero.
+                for grp in OPTIONAL_BLOCK_GROUPS:
+                    srcs = [src for _, src in grp]
+                    have = cols.shape[1] > max(GCOL[s] for s in srcs)
+                    if have and bool(np.stack([col(s)
+                                               for s in srcs]).any()):
+                        for key, src in grp:
+                            per[key] = col(src).tolist()
                 out["per_window"] = per
                 out["deltas"] = {
                     "received": np.diff(col("received"),

@@ -8,6 +8,10 @@ verifies the tentpole contract end to end:
   * stdout is byte-identical,
   * the JSONL streams match event-for-event (modulo wall clocks),
   * the fast run carries the `result` and `telemetry` records,
+  * the v4 header names every column table (including the spatial-panel
+    registries) and every source column the optional-block registry
+    (telemetry.OPTIONAL_BLOCK_GROUPS) emits from,
+  * each optional per-window block group is emitted whole or not at all,
   * exit codes agree.
 
 Exits nonzero on any mismatch.  Runs on CPU in ~a minute.
@@ -80,19 +84,22 @@ def main() -> int:
             if required not in events:
                 print(f"FAIL: fast JSONL missing event={required!r}")
                 ok = False
-        # Schema v3: the stream opens with the named-column header and it
+        # Schema v4: the stream opens with the named-column header and it
         # must match the code's column tables exactly (a drifted header
         # means npz/JSONL consumers are reading the wrong columns).
         sys.path.insert(0, REPO)
         from gossip_simulator_tpu.utils.artifact import TRAJECTORY_COLS
         from gossip_simulator_tpu.utils.metrics import SCHEMA_VERSION
-        from gossip_simulator_tpu.utils.telemetry import (GOSSIP_COLS,
-                                                          OVERLAY_COLS)
+        from gossip_simulator_tpu.utils.telemetry import (
+            GOSSIP_COLS, OPTIONAL_BLOCK_GROUPS, OVERLAY_COLS,
+            SPATIAL_GROUP_COLS, SPATIAL_SHARD_COLS)
         if fast and fast[0]["event"] == "header":
             head = fast[0]
             want = {"gossip": list(GOSSIP_COLS),
                     "overlay": list(OVERLAY_COLS),
-                    "trajectory": list(TRAJECTORY_COLS)}
+                    "trajectory": list(TRAJECTORY_COLS),
+                    "spatial_group": list(SPATIAL_GROUP_COLS),
+                    "spatial_shard": list(SPATIAL_SHARD_COLS)}
             if head.get("columns") != want:
                 print(f"FAIL: header columns {head.get('columns')} != "
                       f"{want}")
@@ -101,25 +108,35 @@ def main() -> int:
                 print(f"FAIL: header schema_version "
                       f"{head.get('schema_version')} != {SCHEMA_VERSION}")
                 ok = False
-            # The exchange-pipeline column (ISSUE 13) must be named in
-            # the header even on this single-device run -- consumers key
-            # per-window arrays off the header, so a build that dropped
-            # the column would silently shift everything after it.
-            if "exchange_inflight_hwm" not in head.get(
-                    "columns", {}).get("gossip", []):
-                print("FAIL: header gossip columns missing "
-                      "exchange_inflight_hwm")
-                ok = False
-            # Same contract for the numeric-gossip error column (ISSUE
-            # 14): named in the header on every run so pushsum JSONL
-            # consumers can key it positionally.
-            if "relerr_ppb" not in head.get(
-                    "columns", {}).get("gossip", []):
-                print("FAIL: header gossip columns missing relerr_ppb")
-                ok = False
+            # Optional trailing per-window blocks, validated against the
+            # registry that EMITS them (telemetry.OPTIONAL_BLOCK_GROUPS)
+            # rather than per-column name literals: every source column
+            # the registry names must exist in the header -- consumers
+            # key per-window arrays off the header, so a build that
+            # dropped one would silently shift everything after it.
+            gossip_cols = head.get("columns", {}).get("gossip", [])
+            for grp in OPTIONAL_BLOCK_GROUPS:
+                for _key, src in grp:
+                    if src not in gossip_cols:
+                        print("FAIL: header gossip columns missing "
+                              f"registry source column {src!r}")
+                        ok = False
         else:
-            print("FAIL: JSONL stream does not open with the v3 header")
+            print("FAIL: JSONL stream does not open with the v4 header")
             ok = False
+        # The all-or-nothing block contract: each registry group travels
+        # whole in the telemetry record's per_window dict (a partial
+        # group means an emitter skipped a column and positional
+        # consumers of the quartet would misattribute the rest).
+        telem_recs = [r for r in fast if r["event"] == "telemetry"]
+        per = telem_recs[0].get("per_window", {}) if telem_recs else {}
+        for grp in OPTIONAL_BLOCK_GROUPS:
+            present = [key for key, _src in grp if key in per]
+            if present and len(present) != len(grp):
+                missing = [key for key, _src in grp if key not in per]
+                print(f"FAIL: per_window block group partially emitted: "
+                      f"have {present}, missing {missing}")
+                ok = False
         if ok:
             t = [r for r in fast if r["event"] == "telemetry"][0]
             print("OK: stdout byte-identical, "

@@ -2,7 +2,7 @@
 """Structural diff of two `-run-dir` artifacts (see utils/artifact.py).
 
     python scripts/compare_runs.py RUN_A RUN_B [--timing-tolerance 0.25]
-                                               [--strict-timing]
+                                               [--strict-timing] [--json]
 
 Answers the regression question in CI-consumable form:
 
@@ -13,13 +13,24 @@ Answers the regression question in CI-consumable form:
     then the FIRST divergent telemetry window -- named row index
     plus the differing columns by name with both values,
   * final-Stats deltas from result.json (any delta = divergence),
+  * spatial-panel deltas (telemetry.npz `spatial_group` / `spatial_shard`
+    / `spatial_traffic`): first divergent window per panel, or a
+    shape/presence mismatch when only one run recorded panels,
   * resolved-gate set differences (a gate flip explains a trajectory
     delta before the code is suspect),
   * phase wall-time ratios against a tolerance band -- informational by
     default, failing only under --strict-timing (wall clocks are noisy).
 
+``--json`` replaces the prose report with one machine-readable JSON
+document on stdout: ``{"exit_code", "diverged", "fingerprint": {"a",
+"b", "match"}, "first_divergent_window", "differing_columns",
+"result_deltas", "panel_deltas", "gate_deltas", "timing_notes"}`` --
+the CI-consumable form (first divergent window, differing columns and
+panels, the exit code it will return).
+
 Exit codes: 0 identical trajectories, 1 divergence, 2 artifact error
-(missing/unreadable run dir).
+(missing/unreadable run dir).  --json keeps the same codes; the
+document's ``exit_code`` field mirrors the process exit status.
 """
 
 from __future__ import annotations
@@ -49,46 +60,94 @@ STAT_FIELDS = ("round", "coverage", "converged", "reason",
                "converged_eps", "eps_ticks", "relerr_ppb")
 
 
-def _first_divergent_window(ta, tb) -> list[str]:
+def _first_divergent_window(ta, tb, report: dict) -> list[str]:
     """Name the first row where the two canonical trajectories differ,
-    and the differing columns within it."""
+    and the differing columns within it; mirror both into `report`."""
     lines = []
     if ta is None or tb is None:
         missing = "A" if ta is None else "B"
+        report["trajectory_missing"] = missing
         lines.append(f"  run {missing} has no trajectory array "
                      "(telemetry.npz absent or empty)")
         return lines
     n = min(len(ta), len(tb))
     for w in range(n):
         if (ta[w] != tb[w]).any():
-            cols = [f"{name} {int(ta[w][i])} vs {int(tb[w][i])}"
-                    for i, name in enumerate(TRAJECTORY_COLS)
-                    if ta[w][i] != tb[w][i]]
+            report["first_divergent_window"] = w
+            report["differing_columns"] = [
+                {"column": name, "a": int(ta[w][i]), "b": int(tb[w][i])}
+                for i, name in enumerate(TRAJECTORY_COLS)
+                if ta[w][i] != tb[w][i]]
+            cols = [f"{d['column']} {d['a']} vs {d['b']}"
+                    for d in report["differing_columns"]]
             lines.append(f"  first divergent window: {w} "
                          f"({'; '.join(cols)})")
             return lines
     if len(ta) != len(tb):
+        report["trajectory_lengths"] = [len(ta), len(tb)]
         lines.append(f"  trajectories share the first {n} windows but "
                      f"differ in length ({len(ta)} vs {len(tb)} windows)")
     return lines
 
 
+# Spatial-panel arrays in telemetry.npz (ISSUE 16): recording-invisible
+# gauges, so a presence/shape mismatch is a config difference (spatial
+# on vs off twin) while a VALUE mismatch with both present is a real
+# divergence -- panels are deterministic functions of the trajectory.
+PANEL_KEYS = ("spatial_group", "spatial_shard", "spatial_traffic")
+
+
+def _panel_deltas(ta: dict, tb: dict) -> tuple[list[dict], bool]:
+    """Diff the spatial panels; return (deltas, any_value_divergence)."""
+    import numpy as np
+
+    deltas: list[dict] = []
+    diverged = False
+    for key in PANEL_KEYS:
+        pa, pb = ta.get(key), tb.get(key)
+        if pa is None and pb is None:
+            continue
+        if pa is None or pb is None:
+            deltas.append({"panel": key, "kind": "presence",
+                           "a": pa is not None, "b": pb is not None})
+            continue
+        if pa.shape != pb.shape:
+            deltas.append({"panel": key, "kind": "shape",
+                           "a": list(pa.shape), "b": list(pb.shape)})
+            continue
+        neq = np.argwhere(pa != pb)
+        if len(neq):
+            w = int(neq[0][0])
+            deltas.append({"panel": key, "kind": "value",
+                           "first_divergent_window": w,
+                           "cells": int((pa[w] != pb[w]).sum())})
+            diverged = True
+    return deltas, diverged
+
+
 def compare(a: dict, b: dict, timing_tolerance: float,
-            strict_timing: bool) -> int:
-    """Print the diff; return the exit code."""
+            strict_timing: bool, as_json: bool = False) -> int:
+    """Print the diff (prose, or one JSON document under --json);
+    return the exit code."""
     ra, rb = a["result"], b["result"]
     diverged = False
     ga = a["config"].get("resolved", {})
     gb = b["config"].get("resolved", {})
+    lines: list[str] = []
+    report: dict = {"a": a["path"], "b": b["path"],
+                    "result_deltas": [], "panel_deltas": [],
+                    "gate_deltas": [], "timing_notes": []}
 
     fa = ra.get("fingerprint")
     fb = rb.get("fingerprint")
+    report["fingerprint"] = {"a": fa, "b": fb,
+                             "match": fa == fb and fa is not None}
     if fa == fb and fa is not None:
-        print(f"fingerprint: MATCH {fa} "
-              f"(basis {ra.get('fingerprint_basis')})")
+        lines.append(f"fingerprint: MATCH {fa} "
+                     f"(basis {ra.get('fingerprint_basis')})")
     else:
         diverged = True
-        print(f"fingerprint: DIVERGED {fa} vs {fb}")
+        lines.append(f"fingerprint: DIVERGED {fa} vs {fb}")
         # A tuning-table mismatch is the FIRST suspect: two runs resolving
         # different tuned-constant entries are EXPECTED to stay
         # trajectory-identical (every persisted tunable passed the
@@ -97,32 +156,55 @@ def compare(a: dict, b: dict, timing_tolerance: float,
         # detail.
         tta, ttb = ga.get("tuning_table"), gb.get("tuning_table")
         if tta != ttb:
-            print(f"  tuning-table mismatch: {tta} vs {ttb} -- a "
-                  "non-neutral table entry is the first suspect "
-                  "(scripts/autotune.py gate should have rejected it)")
-        for line in _first_divergent_window(
-                a["telemetry"].get("trajectory"),
-                b["telemetry"].get("trajectory")):
-            print(line)
+            report["tuning_table_mismatch"] = [tta, ttb]
+            lines.append(f"  tuning-table mismatch: {tta} vs {ttb} -- a "
+                         "non-neutral table entry is the first suspect "
+                         "(scripts/autotune.py gate should have rejected "
+                         "it)")
+        lines.extend(_first_divergent_window(
+            a["telemetry"].get("trajectory"),
+            b["telemetry"].get("trajectory"), report))
 
     for field in STAT_FIELDS:
         va, vb = ra.get(field), rb.get(field)
         if va != vb:
             diverged = True
-            print(f"result.{field}: {va} vs {vb}")
+            report["result_deltas"].append(
+                {"field": field, "a": va, "b": vb})
+            lines.append(f"result.{field}: {va} vs {vb}")
     ba, bb = ra.get("fingerprint_basis"), rb.get("fingerprint_basis")
     if ba != bb:
         # A path difference (telemetry fast path vs windowed loop), not a
         # trajectory difference -- the fingerprint itself already proves
         # the two bases agree row-for-row.
-        print(f"fingerprint basis: {ba} vs {bb} (informational)")
+        report["fingerprint_basis"] = [ba, bb]
+        lines.append(f"fingerprint basis: {ba} vs {bb} (informational)")
+
+    panel_deltas, panels_diverged = _panel_deltas(a["telemetry"],
+                                                  b["telemetry"])
+    report["panel_deltas"] = panel_deltas
+    diverged = diverged or panels_diverged
+    for d in panel_deltas:
+        if d["kind"] == "value":
+            lines.append(f"panel {d['panel']}: first divergent window "
+                         f"{d['first_divergent_window']} "
+                         f"({d['cells']} differing cells)")
+        elif d["kind"] == "shape":
+            lines.append(f"panel {d['panel']}: shape {d['a']} vs {d['b']} "
+                         "(geometry difference)")
+        else:
+            have = "A" if d["a"] else "B"
+            lines.append(f"panel {d['panel']}: only run {have} recorded "
+                         "it (spatial on/off config difference)")
 
     for key in sorted(set(ga) | set(gb)):
         if ga.get(key) != gb.get(key):
             # Not a divergence by itself, but the first place to look
             # when the trajectory diverged.
-            print(f"gate {key}: {ga.get(key)} vs {gb.get(key)} "
-                  "(config difference)")
+            report["gate_deltas"].append(
+                {"gate": key, "a": ga.get(key), "b": gb.get(key)})
+            lines.append(f"gate {key}: {ga.get(key)} vs {gb.get(key)} "
+                         "(config difference)")
 
     pa = ra.get("phases_s") or {}
     pb = rb.get("phases_s") or {}
@@ -132,16 +214,29 @@ def compare(a: dict, b: dict, timing_tolerance: float,
         ratio = vb / base
         if abs(ratio - 1.0) > timing_tolerance:
             tag = "FAIL" if strict_timing else "note"
-            print(f"timing {phase}: {va:.3f}s vs {vb:.3f}s "
-                  f"(ratio {ratio:.2f}, tolerance "
-                  f"{1 - timing_tolerance:.2f}..{1 + timing_tolerance:.2f}) "
-                  f"[{tag}]")
+            report["timing_notes"].append(
+                {"phase": phase, "a_s": va, "b_s": vb,
+                 "ratio": round(ratio, 4), "tag": tag})
+            lines.append(
+                f"timing {phase}: {va:.3f}s vs {vb:.3f}s "
+                f"(ratio {ratio:.2f}, tolerance "
+                f"{1 - timing_tolerance:.2f}..{1 + timing_tolerance:.2f}) "
+                f"[{tag}]")
             if strict_timing:
                 diverged = True
 
     if not diverged:
-        print("OK: runs are trajectory-identical")
-    return 1 if diverged else 0
+        lines.append("OK: runs are trajectory-identical")
+    code = 1 if diverged else 0
+    if as_json:
+        import json
+
+        report["diverged"] = diverged
+        report["exit_code"] = code
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    else:
+        print("\n".join(lines))
+    return code
 
 
 def main(argv=None) -> int:
@@ -154,15 +249,26 @@ def main(argv=None) -> int:
     p.add_argument("--strict-timing", action="store_true",
                    help="timing-band violations fail the comparison "
                         "(default: informational)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable JSON document instead "
+                        "of the prose report (same exit codes; the "
+                        "document carries exit_code)")
     args = p.parse_args(argv)
     try:
         a = load_run(args.run_a)
         b = load_run(args.run_b)
     except (FileNotFoundError, ValueError, OSError) as e:
-        print(f"ERROR: {e}")
+        if args.json:
+            import json
+            print(json.dumps({"error": str(e), "exit_code": 2,
+                              "diverged": None}))
+        else:
+            print(f"ERROR: {e}")
         return 2
-    print(f"A: {a['path']}\nB: {b['path']}")
-    return compare(a, b, args.timing_tolerance, args.strict_timing)
+    if not args.json:
+        print(f"A: {a['path']}\nB: {b['path']}")
+    return compare(a, b, args.timing_tolerance, args.strict_timing,
+                   as_json=args.json)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,378 @@
+"""Spatial telemetry + shard-health watchdog (ISSUE 16).
+
+Four surfaces:
+* ``-telemetry-spatial off`` (the default) A/B pins: trajectory
+  fingerprints hard-coded from the PRE-spatial build on all four engine
+  combos (the same constants test_multirumor's pins carry -- the
+  tier-1 lineage), so arming nothing leaves the traced program
+  bit-identical to HEAD.
+* Recording invisibility: a spatial-on twin matches its off twin
+  byte-for-byte on stdout and JSONL (modulo wall clocks) and
+  fingerprint-exactly on the trajectory -- the panels ride the record
+  scatter, never the physics.
+* Panel semantics: per-group gauges reconcile EXACTLY against the
+  global columns every window (grouped scenario), and the exchange
+  traffic matrix's column sums equal each shard's delivered-lane gauge.
+* The watchdog: unit predicates over hand-built panels, the driver's
+  health.json artifact, and compare_runs --json over a spatial twin
+  pair.
+"""
+
+import hashlib
+import importlib.util
+import io
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.utils import health
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+from gossip_simulator_tpu.utils.telemetry import GCOL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE = dict(graph="kout", fanout=6, seed=3, crashrate=0.01,
+            coverage_target=0.95, progress=False)
+
+GROUPED_SCENARIO = json.dumps({
+    "groups": 4,
+    "events": [{"type": "crash", "at": 30, "frac": 0.5, "group": 1}]})
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fingerprint(cfg, max_windows=400):
+    """Per-window (round, received, message, crashed, removed) trajectory
+    hash via the windowed driver loop (test_scenario.py convention; the
+    same capture the pre-PR constants below were recorded with)."""
+    from gossip_simulator_tpu.backends import make_stepper
+
+    s = make_stepper(cfg)
+    s.init()
+    while not s.overlay_window()[2]:
+        pass
+    s.seed()
+    rows = []
+    for _ in range(max_windows):
+        st = s.gossip_window()
+        rows.append((st.round, st.total_received, st.total_message,
+                     st.total_crashed, st.total_removed))
+        if st.coverage >= cfg.coverage_target or s.exhausted:
+            break
+    h = hashlib.sha256(json.dumps(rows).encode()).hexdigest()[:16]
+    return {"windows": len(rows), "final": list(rows[-1]), "hash": h}
+
+
+def _snapshot(**kw):
+    """Fast-path run returning (RunResult, fetched gossip snapshot)."""
+    from gossip_simulator_tpu.backends import make_stepper
+
+    cfg = Config(**{**BASE, **kw}).validate()
+    s = make_stepper(cfg)
+    res = run_simulation(cfg, stepper=s, silent=True)
+    return res, s._telem.gossip_snapshot()
+
+
+def _capture(tmp_path, tag, **kw):
+    cfg = Config(**{**BASE, **kw}).validate()
+    buf = io.StringIO()
+    p = tmp_path / f"{tag}.jsonl"
+    with ProgressPrinter(enabled=True, jsonl_path=str(p),
+                         out=buf) as printer:
+        res = run_simulation(cfg, printer=printer)
+    recs = [json.loads(line) for line in p.read_text().splitlines()]
+    return buf.getvalue(), recs, res
+
+
+# ---------------------------------------------------------------------------
+# Default-path bit-identity pins (spatial off == the pre-spatial build)
+# ---------------------------------------------------------------------------
+
+# Captured at the pre-spatial HEAD on the tier-1 CPU host -- the same
+# lineage constants test_multirumor.PRE_MULTIRUMOR_FP pins (unchanged
+# since commit 985cea5, re-verified at this PR's base).
+PRE_SPATIAL_FP = {
+    "jax_event": {"windows": 9, "final": [90, 2928, 12791, 125, 0],
+                  "hash": "477b07759900a563"},
+    "jax_ring": {"windows": 9, "final": [90, 2940, 13034, 126, 0],
+                 "hash": "33a08f76cf24827b"},
+    "sharded_event": {"windows": 10, "final": [100, 3890, 18320, 204, 0],
+                      "hash": "b8c00f159feac434"},
+    "sharded_ring": {"windows": 11, "final": [110, 3910, 17988, 191, 0],
+                     "hash": "a7f0a9290df481e5"},
+}
+
+FP_COMBOS = {
+    "jax_event": dict(n=3000, backend="jax", engine="event"),
+    "jax_ring": dict(n=3000, backend="jax", engine="ring"),
+    "sharded_event": dict(n=4000, backend="sharded", engine="event"),
+    "sharded_ring": dict(n=4000, backend="sharded", engine="ring"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(FP_COMBOS))
+def test_spatial_off_bit_identical(name):
+    """-telemetry-spatial off (the default) must leave all four engine
+    combos bit-identical to the pre-spatial build: spatial_spec returns
+    None and every panel gate is a Python-static branch, so the traced
+    program -- and therefore the trajectory -- is unchanged."""
+    cfg = Config(**BASE, **FP_COMBOS[name]).validate()
+    assert not cfg.telemetry_spatial_enabled
+    assert _fingerprint(cfg) == PRE_SPATIAL_FP[name]
+
+
+@pytest.mark.parametrize("name", sorted(FP_COMBOS))
+def test_spatial_on_trajectory_identical(name):
+    """Arming the panels must not move the trajectory: the probe reads
+    state, never writes it, and the exch_counts leaf is a gauge outside
+    the physics.  Fingerprint-exact against the same pre-spatial pins."""
+    cfg = Config(**BASE, **FP_COMBOS[name],
+                 telemetry_spatial="on").validate()
+    assert cfg.telemetry_spatial_enabled
+    assert _fingerprint(cfg) == PRE_SPATIAL_FP[name]
+
+
+# ---------------------------------------------------------------------------
+# Recording invisibility: on/off twins byte-identical
+# ---------------------------------------------------------------------------
+
+def _strip(rec):
+    # wall_s / phases_s and the telemetry record's *_per_sec throughput
+    # figures are wall-clock-derived; everything else must match.
+    return {k: v for k, v in rec.items()
+            if k not in ("wall_s", "phases_s",
+                         "node_updates_per_sec", "messages_per_sec")}
+
+
+@pytest.mark.parametrize("combo", ["jax_event", "sharded_event"])
+def test_spatial_on_off_byte_parity(tmp_path, combo):
+    """A spatial-on run's stdout and JSONL must match its off twin
+    byte-for-byte (modulo wall clocks): panels are npz-only, and the v4
+    header's spatial registries are STATIC, present either way."""
+    kw = FP_COMBOS[combo]
+    out_off, recs_off, res_off = _capture(tmp_path, f"{combo}_off", **kw)
+    out_on, recs_on, res_on = _capture(tmp_path, f"{combo}_on", **kw,
+                                       telemetry_spatial="on")
+    assert out_on == out_off
+    assert [_strip(r) for r in recs_on] == [_strip(r) for r in recs_off]
+    assert res_on.stats.to_dict() == res_off.stats.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# Panel semantics: exact reconciliation
+# ---------------------------------------------------------------------------
+
+def test_grouped_scenario_panels_reconcile():
+    """Per-group gauges must sum EXACTLY to the existing global columns
+    every window (received/removed; down == scen_crashed at crashrate 0
+    with a recovery-free timeline), and the wave's crashes must be
+    attributed to group 1 alone."""
+    res, h = _snapshot(n=3000, backend="jax", engine="event",
+                       crashrate=0.0, telemetry_spatial="on",
+                       scenario=GROUPED_SCENARIO)
+    count = h["count"]
+    c = h["cols"][:count]
+    grp = h["spatial_group"]
+    assert grp.shape == (count, 4, 3)
+    assert (grp[:, :, 0].sum(axis=1) == c[:, GCOL["received"]]).all()
+    assert (grp[:, :, 2].sum(axis=1) == c[:, GCOL["removed"]]).all()
+    # crashrate 0 + no recovery events: the down gauge IS the scenario
+    # wave, window for window, and only group 1 carries it.
+    assert (grp[:, :, 1].sum(axis=1) == c[:, GCOL["scen_crashed"]]).all()
+    assert c[-1, GCOL["scen_crashed"]] > 0
+    assert grp[-1, 1, 1] == c[-1, GCOL["scen_crashed"]]
+    assert (grp[-1, [0, 2, 3], 1] == 0).all()
+
+
+def test_sharded_traffic_matrix_sums():
+    """The exchange traffic matrix is cumulative routed-lane counts:
+    column sums equal each shard's delivered-lane gauge (exch_rcvd)
+    every window, rows/columns are monotone, and by convergence every
+    shard pair has exchanged (full 8x8 support on the kout overlay)."""
+    res, h = _snapshot(n=4000, backend="sharded", engine="event",
+                       telemetry_spatial="on")
+    count = h["count"]
+    shd, tr = h["spatial_shard"], h["spatial_traffic"]
+    s = tr.shape[1]
+    assert s == jax.device_count()
+    assert tr.shape == (count, s, s)
+    rcvd = shd[:, :, 4]
+    assert (tr.sum(axis=1) == rcvd).all()
+    assert (np.diff(tr, axis=0) >= 0).all()
+    if s > 1:
+        assert (tr[-1] > 0).all()
+    # Send-side conservation: every dispatched lane the matrix counted
+    # was delivered somewhere (rank-past-cap lanes are counted in the
+    # overflow gauge instead, never in the matrix).
+    assert tr[-1].sum() == rcvd[-1].sum()
+
+
+def test_shard_panel_mail_high_matches_global():
+    """The shard panel's occupancy column maxes to the global mail_high
+    gauge (same probe, per-shard attribution)."""
+    res, h = _snapshot(n=4000, backend="sharded", engine="event",
+                       telemetry_spatial="on")
+    c = h["cols"][:h["count"]]
+    shd = h["spatial_shard"]
+    assert (shd[:, :, 0].max(axis=1) == c[:, GCOL["mail_high"]]).all()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog predicates (hand-built panels)
+# ---------------------------------------------------------------------------
+
+def _panels(group, shard):
+    group = np.asarray(group, np.int32)
+    shard = np.asarray(shard, np.int32)
+    return {"count": group.shape[0], "spatial_group": group,
+            "spatial_shard": shard,
+            "spatial_traffic": np.zeros(
+                (group.shape[0], shard.shape[1], shard.shape[1]),
+                np.int32)}
+
+
+def _shard_rows(mail_high, exch_rcvd):
+    w, s = len(mail_high), len(mail_high[0])
+    out = np.zeros((w, s, 5), np.int32)
+    out[:, :, 0] = mail_high
+    out[:, :, 4] = exch_rcvd
+    return out
+
+
+def test_health_no_data():
+    assert health.evaluate_health(None)["status"] == "no-data"
+    assert health.evaluate_health({"count": 3})["status"] == "no-data"
+
+
+def test_health_ok_on_healthy_run():
+    g = [[[10 * w, 0, 0]] for w in range(1, 6)]
+    s = _shard_rows([[3]] * 5, [[w] for w in range(1, 6)])
+    v = health.evaluate_health(_panels(g, s), cap=8)
+    assert v["status"] == "ok" and v["findings"] == []
+    assert set(v["checks"]) == {"occupancy_stuck_at_cap",
+                                "group_coverage_stall"}
+
+
+def test_health_occupancy_stuck_at_cap():
+    s = _shard_rows([[2, 8], [8, 8], [3, 8], [4, 8]],
+                    [[1, 1], [2, 2], [3, 3], [4, 4]])
+    g = [[[w, 0, 0]] for w in range(1, 5)]
+    v = health.evaluate_health(_panels(g, s), cap=8)
+    assert v["status"] == "degraded"
+    (f,) = [x for x in v["findings"]
+            if x["check"] == "occupancy_stuck_at_cap"]
+    assert f["subject"] == "shard" and f["index"] == 1
+    # Without a cap (ring engine) the check is skipped entirely.
+    v2 = health.evaluate_health(_panels(g, s), cap=None)
+    assert "occupancy_stuck_at_cap" not in v2["checks"]
+
+
+def test_health_zero_delivery_shard():
+    rcvd = [[1, 1], [2, 1], [3, 1], [4, 1], [5, 1]]
+    s = _shard_rows([[2, 2]] * 5, rcvd)
+    g = [[[w, 0, 0]] for w in range(1, 6)]
+    v = health.evaluate_health(_panels(g, s))
+    (f,) = [x for x in v["findings"]
+            if x["check"] == "zero_delivery_shard"]
+    assert f["index"] == 1
+    # All shards silent (the run is over): siblings set no bar, no
+    # finding.
+    s_all = _shard_rows([[2, 2]] * 5, [[3, 3]] * 5)
+    v2 = health.evaluate_health(_panels(g, s_all))
+    assert not [x for x in v2["findings"]
+                if x["check"] == "zero_delivery_shard"]
+
+
+def test_health_group_coverage_stall():
+    # Group 1 stalls at 5 (peak 9 earlier -- crashed nodes lowered it)
+    # while group 0 keeps growing; group 2 sits AT its peak
+    # (saturated == done, not stalled).
+    recv = np.array([[10, 9, 20], [20, 5, 20], [30, 5, 20],
+                     [40, 5, 20], [50, 5, 20]], np.int32)
+    grp = np.zeros((5, 3, 3), np.int32)
+    grp[:, :, 0] = recv
+    s = _shard_rows([[2]] * 5, [[w] for w in range(1, 6)])
+    v = health.evaluate_health(
+        {"count": 5, "spatial_group": grp, "spatial_shard": s,
+         "spatial_traffic": np.zeros((5, 1, 1), np.int32)})
+    stalls = [x for x in v["findings"]
+              if x["check"] == "group_coverage_stall"]
+    assert [x["index"] for x in stalls] == [1]
+
+
+def test_report_health_returns_verdict():
+    v = {"status": "ok", "windows": 4, "checks": [], "findings": []}
+    assert health.report_health(v) is v
+
+
+def test_ring_slot_cap_per_engine():
+    cfg_ev = Config(**BASE, n=4000, backend="jax",
+                    engine="event").validate()
+    assert health.ring_slot_cap(cfg_ev) > 0
+    cfg_ring = Config(**BASE, n=4000, backend="jax",
+                      engine="ring").validate()
+    assert health.ring_slot_cap(cfg_ring) is None
+
+
+# ---------------------------------------------------------------------------
+# Artifacts: npz panels, health.json, compare_runs --json
+# ---------------------------------------------------------------------------
+
+def test_run_dir_artifacts_and_compare_json(tmp_path, capsys):
+    """A spatial run archives the panels + a health verdict; its off
+    twin compares trajectory-identical (exit 0) with the panel
+    difference surfaced as a config note, and --json carries the same
+    verdict machine-readably."""
+    da, db = str(tmp_path / "on"), str(tmp_path / "off")
+    kw = dict(**BASE, n=2000, backend="jax", engine="event",
+              scenario=GROUPED_SCENARIO)
+    for d, spatial in ((da, "on"), (db, "off")):
+        cfg = Config(**kw, telemetry_spatial=spatial,
+                     run_dir=d).validate()
+        # Run-dir archiving is gated on a non-silent printer.
+        with ProgressPrinter(enabled=False, out=io.StringIO()) as printer:
+            run_simulation(cfg, printer=printer)
+    z = np.load(os.path.join(da, "telemetry.npz"))
+    assert z["spatial_group"].shape[1:] == (4, 3)
+    assert [str(x) for x in z["spatial_group_names"]] == \
+        ["received", "down", "removed"]
+    verdict = json.load(open(os.path.join(da, "health.json")))
+    assert verdict["status"] in ("ok", "degraded")
+    assert verdict["windows"] == z["spatial_group"].shape[0]
+    assert not os.path.exists(os.path.join(db, "health.json"))
+
+    cmp_mod = _load_script("compare_runs")
+    assert cmp_mod.main([da, db]) == 0
+    capsys.readouterr()
+    assert cmp_mod.main([da, db, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["exit_code"] == 0 and doc["diverged"] is False
+    assert doc["fingerprint"]["match"] is True
+    assert {d["panel"] for d in doc["panel_deltas"]} == \
+        {"spatial_group", "spatial_shard", "spatial_traffic"}
+    assert all(d["kind"] == "presence" for d in doc["panel_deltas"])
+
+    # Perturbed seed: --json names the first divergent window and exits 1.
+    dc = str(tmp_path / "seed5")
+    cfg = Config(**{**kw, "seed": 5}, telemetry_spatial="on",
+                 run_dir=dc).validate()
+    with ProgressPrinter(enabled=False, out=io.StringIO()) as printer:
+        run_simulation(cfg, printer=printer)
+    capsys.readouterr()
+    assert cmp_mod.main([da, dc, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["diverged"] is True and doc["exit_code"] == 1
+    assert doc["fingerprint"]["match"] is False
+    assert isinstance(doc.get("first_divergent_window"), int)
+    assert doc["differing_columns"]
