@@ -1,0 +1,34 @@
+"""gossip-lint: repo-specific invariant analyzer (ISSUE 17).
+
+Every perf and scale claim in this repo rests on bit-exact trajectory
+fingerprint pins; the invariants that make those pins meaningful are
+mechanical, so they are checked mechanically:
+
+    donation-aliasing   copy-in/copy-out discipline around donated buffers
+                        (the PR-2 zero-copy snapshot bug class, both the
+                        save side and read-after-donate)
+    dtype-discipline    SoA columns / mail-ring lanes stay inside the
+                        declared integer dtype set; no weak-type floats or
+                        implicit int64 entering traced arithmetic
+    trace-purity        no host nondeterminism (time.*, random.*,
+                        np.random.*, .item(), int(tracer), data-dependent
+                        Python branches) inside traced code
+    donation-coverage   hot-path jits in ops/ and parallel/ that carry
+                        state declare donate_argnums
+
+Static rules are pure-stdlib AST passes (`python -m
+gossip_simulator_tpu.analysis` never imports JAX); the runtime half
+(`analysis.runtime`, driven by scripts/check_compile_budget.py) watches
+`jax.log_compiles` and asserts per-entrypoint compile counts against the
+committed COMPILE_BUDGET.json so retrace regressions fail CI with the
+guilty call site named.
+
+Inline suppression:  # gossip-lint: allow(<rule>) <reason>
+Baseline:            analysis/baseline.json (grandfathered fingerprints;
+                     shipped empty -- HEAD is clean)
+Exit code:           the number of unsuppressed, unbaselined findings.
+"""
+
+from gossip_simulator_tpu.analysis.core import (  # noqa: F401
+    Finding, load_baseline, run_analysis, write_baseline)
+from gossip_simulator_tpu.analysis.rules import RULES  # noqa: F401

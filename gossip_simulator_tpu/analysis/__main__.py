@@ -1,0 +1,103 @@
+"""CLI: ``python -m gossip_simulator_tpu.analysis [paths...]``.
+
+Exit code is the number of unsuppressed, unbaselined findings (clamped
+to 125 so it never collides with signal exit codes).  Never imports JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from gossip_simulator_tpu.analysis import core
+from gossip_simulator_tpu.analysis.rules import RULES
+
+
+def _repo_root() -> str:
+    # analysis/ -> gossip_simulator_tpu/ -> repo root
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gossip_simulator_tpu.analysis",
+        description="gossip-lint: donation/dtype/purity invariant "
+                    "analyzer (see analysis/__init__.py for the rules)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to scan (default: "
+                         f"{', '.join(core.DEFAULT_SCOPE)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset to run")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: analysis/baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current unsuppressed findings into "
+                         "the baseline file and exit 0")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--cache", default=None, metavar="DIR",
+                    help="per-file result cache directory (CI caches "
+                         "this across runs)")
+    args = ap.parse_args(argv)
+
+    t0 = time.monotonic()
+    root = _repo_root()
+    scope = args.paths or core.DEFAULT_SCOPE
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            ap.error(f"unknown rule(s): {', '.join(unknown)} "
+                     f"(known: {', '.join(RULES)})")
+
+    bl_path = args.baseline or core.baseline_path(root)
+    baseline = set() if args.no_baseline else core.load_baseline(bl_path)
+
+    findings = core.run_analysis(root, scope=scope, rules=rules,
+                                 baseline=baseline, cache_dir=args.cache)
+
+    if args.write_baseline:
+        core.write_baseline(bl_path, findings)
+        n = len([f for f in findings if not f.suppressed])
+        print(f"gossip-lint: baselined {n} finding(s) -> {bl_path}")
+        return 0
+
+    open_findings = core.unsuppressed(findings)
+    elapsed = time.monotonic() - t0
+
+    if args.as_json:
+        report = {
+            "version": 1,
+            "rules": sorted(rules) if rules else sorted(RULES),
+            "counts": {
+                "total": len(findings),
+                "suppressed": sum(f.suppressed for f in findings),
+                "baselined": sum(f.baselined for f in findings),
+                "unsuppressed": len(open_findings),
+            },
+            "findings": [f.to_dict() for f in findings],
+            "elapsed_s": round(elapsed, 3),
+        }
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in findings:
+            print(f.format_human())
+        n_sup = sum(f.suppressed for f in findings)
+        n_bl = sum(f.baselined for f in findings)
+        print(f"gossip-lint: {len(open_findings)} finding(s) "
+              f"({n_sup} suppressed, {n_bl} baselined) "
+              f"in {elapsed:.2f}s")
+
+    return min(len(open_findings), 125)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

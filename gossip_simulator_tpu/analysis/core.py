@@ -1,0 +1,276 @@
+"""gossip-lint driver: findings, suppressions, baseline, file walking.
+
+Pure stdlib -- importing this module (or running the static rules) never
+touches JAX, so the CI lint step stays under the 30 s budget cold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+# Default scan scope relative to the repo root.  tests/ is excluded: test
+# files intentionally contain rule-firing fixture snippets.
+DEFAULT_SCOPE = ("gossip_simulator_tpu", "scripts", "bench.py")
+EXCLUDE_PARTS = ("tests", "__pycache__", ".jax_cache")
+
+BASELINE_VERSION = 1
+
+_ALLOW_RE = re.compile(
+    r"#\s*gossip-lint:\s*allow\(([\w,\s-]+)\)\s*(.*)$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable id for baselining: rule + path + the *content* of the
+        flagged line (whitespace-normalized), so the baseline survives
+        pure line moves but a changed line re-fires."""
+        norm = " ".join(self.snippet.split())
+        h = hashlib.sha256(
+            f"{self.rule}|{self.path}|{norm}".encode()).hexdigest()
+        return h[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+            "snippet": self.snippet, "fingerprint": self.fingerprint,
+            "suppressed": self.suppressed, "baselined": self.baselined,
+        }
+
+    def format_human(self) -> str:
+        mark = ""
+        if self.suppressed:
+            mark = " [suppressed]"
+        elif self.baselined:
+            mark = " [baseline]"
+        loc = f"{self.path}:{self.line}:{self.col}"
+        return (f"{loc}: {self.rule}{mark}\n    {self.message}\n"
+                f"    > {self.snippet}")
+
+
+# --------------------------------------------------------------------------
+# Suppressions
+# --------------------------------------------------------------------------
+
+def collect_suppressions(source: str) -> dict[int, set[str]]:
+    """line -> rules allowed on that line.
+
+    ``# gossip-lint: allow(rule[, rule2]) <reason>`` suppresses matching
+    findings on its own line; on a standalone comment line it suppresses
+    the next non-comment line.  A missing reason is itself an error the
+    caller surfaces (we return it under the pseudo-rule ``__noreason__``).
+    """
+    out: dict[int, set[str]] = {}
+    lines = source.splitlines()
+    for i, line in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if not m.group(2).strip():
+            rules = {"__noreason__"}
+        target = i
+        if line.lstrip().startswith("#"):  # standalone comment line
+            j = i + 1
+            while j <= len(lines) and lines[j - 1].lstrip().startswith("#"):
+                j += 1
+            target = j
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def apply_suppressions(findings: list[Finding],
+                       suppressions: dict[int, set[str]],
+                       path: str) -> list[Finding]:
+    """Mark suppressed findings in place; emit a finding for reasonless
+    allow() comments so suppressions stay auditable."""
+    extra: list[Finding] = []
+    for lineno, rules in suppressions.items():
+        if "__noreason__" in rules:
+            extra.append(Finding(
+                rule="lint-usage", path=path, line=lineno, col=1,
+                message="gossip-lint: allow() without a reason -- state "
+                        "why the finding is safe",
+                snippet=""))
+    for f in findings:
+        allowed = suppressions.get(f.line, set())
+        if f.rule in allowed or "all" in allowed:
+            f.suppressed = True
+    return findings + extra
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+
+def baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, "gossip_simulator_tpu", "analysis",
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    fps = sorted({f.fingerprint for f in findings if not f.suppressed})
+    with open(path, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": fps}, f,
+                  indent=2)
+        f.write("\n")
+
+
+# --------------------------------------------------------------------------
+# Result cache (tier1.yml caches this dir across runs)
+# --------------------------------------------------------------------------
+
+class ResultCache:
+    """Per-file finding cache keyed on content hash: unchanged files skip
+    the AST passes entirely.  Safe because the rules are pure functions
+    of a single file's source (path policy is part of the key)."""
+
+    def __init__(self, cache_dir: Optional[str]):
+        self.dir = cache_dir
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+
+    def key(self, relpath: str, source: str) -> str:
+        return hashlib.sha256(
+            f"{relpath}|{_RULES_DIGEST}|{source}".encode()).hexdigest()
+
+    def get(self, key: str) -> Optional[list[dict]]:
+        if not self.dir:
+            return None
+        p = os.path.join(self.dir, key + ".json")
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def put(self, key: str, findings: list[Finding]) -> None:
+        if not self.dir:
+            return
+        p = os.path.join(self.dir, key + ".json")
+        with open(p, "w") as f:
+            json.dump([dataclasses.asdict(x) for x in findings], f)
+
+
+def _rules_digest() -> str:
+    """Hash of the rule implementation -- a rule edit invalidates the
+    whole cache."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for name in ("rules.py", "core.py"):
+        try:
+            with open(os.path.join(here, name), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:16]
+
+
+_RULES_DIGEST = _rules_digest()
+
+
+# --------------------------------------------------------------------------
+# Runner
+# --------------------------------------------------------------------------
+
+def iter_python_files(root: str, scope: Iterable[str]) -> Iterable[str]:
+    for entry in scope:
+        path = os.path.join(root, entry)
+        if os.path.isfile(path) and path.endswith(".py"):
+            yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in EXCLUDE_PARTS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def analyze_source(relpath: str, source: str, *,
+                   rules: Optional[dict] = None,
+                   force_in_scope: bool = False) -> list[Finding]:
+    """Run the rules over one file's source.  ``force_in_scope`` is how
+    test fixtures with synthetic paths opt into every rule."""
+    from gossip_simulator_tpu.analysis import rules as rules_mod
+    active = rules if rules is not None else rules_mod.RULES
+    try:
+        module = rules_mod.Module(relpath, source,
+                                  force_in_scope=force_in_scope)
+    except SyntaxError as e:
+        return [Finding(rule="lint-usage", path=relpath,
+                        line=e.lineno or 1, col=(e.offset or 0) + 1,
+                        message=f"file does not parse: {e.msg}")]
+    findings: list[Finding] = []
+    for fn in active.values():
+        findings.extend(fn(module))
+    findings = apply_suppressions(
+        findings, collect_suppressions(source), relpath)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def run_analysis(root: str, *, scope: Iterable[str] = DEFAULT_SCOPE,
+                 rules: Optional[dict] = None,
+                 baseline: Optional[set[str]] = None,
+                 cache_dir: Optional[str] = None) -> list[Finding]:
+    """Analyze the repo.  Returns every finding (suppressed/baselined ones
+    marked); the unsuppressed count drives the exit code."""
+    root = os.path.abspath(root)
+    cache = ResultCache(cache_dir)
+    selected = None
+    if rules is not None:
+        from gossip_simulator_tpu.analysis import rules as rules_mod
+        selected = {k: rules_mod.RULES[k] for k in rules}
+    findings: list[Finding] = []
+    for path in iter_python_files(root, scope):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        key = cache.key(relpath, source) if cache.dir else ""
+        cached = cache.get(key) if key else None
+        if cached is not None:
+            findings.extend(Finding(**d) for d in cached)
+            continue
+        file_findings = analyze_source(relpath, source, rules=selected)
+        if key:
+            cache.put(key, file_findings)
+        findings.extend(file_findings)
+    if baseline:
+        for f in findings:
+            if not f.suppressed and f.fingerprint in baseline:
+                f.baselined = True
+    return findings
+
+
+def unsuppressed(findings: Iterable[Finding]) -> list[Finding]:
+    return [f for f in findings if not f.suppressed and not f.baselined]

@@ -1,0 +1,770 @@
+"""The four gossip-lint AST rules (pure stdlib -- no JAX import).
+
+Each rule is a function ``rule(module: Module) -> list[Finding]`` registered
+in ``RULES``.  ``Module`` carries the parsed AST plus the shared analyses
+every rule needs: local function defs, dtype aliases, jit sites, and the
+traced-function set (functions reachable from a jax.jit / shard_map /
+lax-control-flow entrypoint, the repo's "inside the tracer" surface).
+
+Scoping is repo policy, declared up top: the rules know which modules hold
+traced code and which hold the checkpoint/snapshot copy-discipline surface.
+A fixture file handed to ``run_analysis`` directly is always in scope for
+every rule (tests exercise each rule on synthetic snippets).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Optional
+
+from gossip_simulator_tpu.analysis.core import Finding
+
+# --------------------------------------------------------------------------
+# Repo policy: which files hold what invariant surface
+# --------------------------------------------------------------------------
+
+# Modules whose functions may run under a tracer: trace-purity and
+# dtype-discipline apply to the traced subset of their functions.
+TRACED_DIRS = ("gossip_simulator_tpu/ops/", "gossip_simulator_tpu/parallel/",
+               "gossip_simulator_tpu/models/")
+
+# exchange.py documents "All functions run INSIDE shard_map": every
+# top-level function there is a traced root even without a visible jit.
+ALL_TRACED_MODULES = ("gossip_simulator_tpu/parallel/exchange.py",)
+
+# Copy-discipline surface for donation-aliasing scope A: modules whose
+# snapshot/save-named functions must copy device buffers before persisting.
+COPY_MODULES = ("gossip_simulator_tpu/utils/checkpoint.py",
+                "gossip_simulator_tpu/utils/artifact.py",
+                "gossip_simulator_tpu/serve.py",
+                "gossip_simulator_tpu/backends/")
+COPY_FUNC_RE = re.compile(r"(state_pytree$|snapshot|^save$|^_host_gather$)")
+
+# donation-coverage applies to the hot-path jit surface.
+DONATION_DIRS = TRACED_DIRS
+
+# Parameter names that mark a jitted callable as carrying donated state.
+STATE_PARAM_NAMES = {"state", "st", "ostate", "tree", "carry", "rings"}
+
+# Parameters that are static-by-convention at trace time: config objects,
+# meshes, and axis names are Python values the tracer never sees.
+STATIC_PARAM_NAMES = {"cfg", "config", "mesh", "axis", "axis_name"}
+
+# Annotations naming a Python scalar mark a parameter as trace-time
+# static (`n_shards: int`, `p: float`, `sort_buckets: bool | None`).
+_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes", "Config"}
+
+# The declared SoA dtype budget (models/state.py: uint8 flags, int32
+# ids/counters, a uint32 [hi, lo] pair instead of int64 scalars, uint16
+# fixed-point limbs).  float32 is allowed for RNG draws / probabilities;
+# float64 would silently retype the bit-exact RNG streams.
+ALLOWED_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+                  "uint32", "uint64", "bool", "bool_", "float32"}
+
+# Canonical spellings for dtype expressions (resolved through module-level
+# aliases like ``I32 = jnp.int32``).
+_DTYPE_CANON = {"bool": "bool", "int": "int64", "float": "float64"}
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_SHARD_MAP_SUFFIX = "shard_map"
+_CTRL_FLOW_BODY_ARGS = {
+    # dotted suffix -> positions of traced callables among positional args
+    "lax.scan": (0,), "lax.while_loop": (0, 1), "lax.fori_loop": (2,),
+    "lax.cond": (1, 2), "lax.switch": (1,), "lax.map": (0,),
+    "jax.vmap": (0,), "vmap": (0,), "jax.checkpoint": (0,),
+}
+
+_ASARRAY_NAMES = {"np.asarray", "numpy.asarray", "jnp.asarray",
+                  "jax.numpy.asarray"}
+_CONSTRUCTORS = {  # dotted suffix -> index of the positional dtype argument
+    "zeros": 1, "ones": 1, "empty": 1, "arange": None, "full": 2,
+}
+_CONSTRUCTOR_PREFIXES = ("np.", "numpy.", "jnp.", "jax.numpy.")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`jnp.zeros` -> "jnp.zeros"; `jax.random.fold_in` ->
+    "jax.random.fold_in"; bare names -> the name; else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _int_tuple(node: Optional[ast.AST]) -> Optional[tuple[int, ...]]:
+    """Literal ints out of `(0, 4)` / `0` / `[1, 2]`; None if not literal."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One jax.jit occurrence: the call/decorator node plus what it wraps."""
+    node: ast.AST  # the jit Call (or partial Call for decorators)
+    subject: Optional[ast.AST]  # FunctionDef or Lambda being jitted
+    subject_name: str
+    donate: Optional[ast.AST]  # the donate_argnums kwarg value node
+    static_argnums: tuple[int, ...]
+
+
+class Module:
+    """Parsed module + the shared analyses the rules consume."""
+
+    def __init__(self, relpath: str, source: str, *,
+                 force_in_scope: bool = False):
+        self.relpath = relpath.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        # Fixture snippets run every rule regardless of path policy.
+        self.force_in_scope = force_in_scope
+        self.defs: dict[str, ast.AST] = {}  # bare name -> FunctionDef/Lambda
+        self.dtype_aliases: dict[str, str] = {}
+        self.jit_sites: list[JitSite] = []
+        self.donating_defs: dict[str, tuple[int, ...]] = {}
+        self._collect_defs_and_aliases()
+        self._collect_jit_sites()
+        self.traced_roots = self._collect_traced_roots()
+        self.traced = self._reach(self.traced_roots)
+
+    # --- scope predicates -------------------------------------------------
+    def in_traced_scope(self) -> bool:
+        return self.force_in_scope or any(
+            self.relpath.startswith(d) for d in TRACED_DIRS)
+
+    def in_copy_scope(self) -> bool:
+        return self.force_in_scope or any(
+            self.relpath.startswith(m) for m in COPY_MODULES)
+
+    def in_donation_scope(self) -> bool:
+        return self.force_in_scope or any(
+            self.relpath.startswith(d) for d in DONATION_DIRS)
+
+    # --- collection -------------------------------------------------------
+    def _collect_defs_and_aliases(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, node)
+        for stmt in self.tree.body:  # module-level dtype aliases only
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                d = dotted(stmt.value)
+                if d is not None:
+                    leaf = d.rsplit(".", 1)[-1]
+                    if leaf in ALLOWED_DTYPES or leaf in (
+                            "float64", "float16", "bfloat16", "complex64",
+                            "complex128"):
+                        self.dtype_aliases[stmt.targets[0].id] = leaf
+
+    def canon_dtype(self, node: ast.AST) -> Optional[str]:
+        """Canonical dtype name for an expression, or None if unknown
+        (string dtypes like "int32" count too)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        d = dotted(node)
+        if d is None:
+            return None
+        if d in self.dtype_aliases:
+            return self.dtype_aliases[d]
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf in _DTYPE_CANON and d == leaf:  # bare builtin `bool`/`int`
+            return _DTYPE_CANON[leaf]
+        known = ALLOWED_DTYPES | {"float64", "float16", "bfloat16",
+                                  "complex64", "complex128"}
+        return leaf if leaf in known else None
+
+    def _jit_call_parts(self, call: ast.Call):
+        """(subject_node, donate_kw, static_argnums) for a `jax.jit(...)`
+        call, else None."""
+        if dotted(call.func) not in _JIT_NAMES:
+            return None
+        subject = call.args[0] if call.args else None
+        donate = None
+        static: tuple[int, ...] = ()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                donate = kw.value
+            elif kw.arg in ("static_argnums", "static_argnames"):
+                static = _int_tuple(kw.value) or ()
+        return subject, donate, static
+
+    def _resolve_subject(self, node: Optional[ast.AST]):
+        """Chase a jit subject expression to a FunctionDef/Lambda:
+        names resolve through local defs and `fn = _shard_map(...)`
+        assignments; `_shard_map(mesh, fn, ...)` / `shard_map(fn, ...)`
+        unwrap to their callable argument."""
+        for _ in range(4):  # bounded chase
+            if node is None:
+                return None, ""
+            if isinstance(node, ast.Lambda):
+                return node, "<lambda>"
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node, node.name
+            if isinstance(node, ast.Name):
+                if node.id in self.defs:
+                    d = self.defs[node.id]
+                    return d, node.id
+                node = self._local_assignment(node.id)
+                continue
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if d.endswith(_SHARD_MAP_SUFFIX):
+                    # _shard_map(mesh, fn, ...) vs shard_map(fn, ...)
+                    idx = 1 if d.lstrip("_").startswith("_") or \
+                        d.split(".")[-1] == "_shard_map" else 0
+                    node = (node.args[idx]
+                            if len(node.args) > idx else None)
+                    continue
+                return None, d  # factory call -- unresolvable statically
+            return None, ""
+        return None, ""
+
+    def _local_assignment(self, name: str) -> Optional[ast.AST]:
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name):
+                return node.value
+        return None
+
+    def _collect_jit_sites(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    site = self._decorator_jit(dec, node)
+                    if site is not None:
+                        self.jit_sites.append(site)
+                        if site.donate is not None:
+                            nums = _int_tuple(site.donate)
+                            if nums:
+                                self.donating_defs[node.name] = nums
+            elif isinstance(node, ast.Call):
+                parts = self._jit_call_parts(node)
+                if parts is None:
+                    continue
+                subject, donate, static = parts
+                sub, name = self._resolve_subject(subject)
+                self.jit_sites.append(JitSite(node, sub, name, donate,
+                                              static))
+
+    def _decorator_jit(self, dec: ast.AST,
+                       fn: ast.FunctionDef) -> Optional[JitSite]:
+        """`@jax.jit` or `@functools.partial(jax.jit, ...)`."""
+        if dotted(dec) in _JIT_NAMES:
+            return JitSite(dec, fn, fn.name, None, ())
+        if (isinstance(dec, ast.Call)
+                and (dotted(dec.func) or "").endswith("partial")
+                and dec.args and dotted(dec.args[0]) in _JIT_NAMES):
+            donate = None
+            static: tuple[int, ...] = ()
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnums":
+                    donate = kw.value
+                elif kw.arg in ("static_argnums", "static_argnames"):
+                    static = _int_tuple(kw.value) or ()
+            return JitSite(dec, fn, fn.name, donate, static)
+        return None
+
+    def _collect_traced_roots(self) -> set[str]:
+        roots: set[str] = set()
+        for site in self.jit_sites:
+            if site.subject is not None and site.subject_name:
+                roots.add(site.subject_name)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if d.split(".")[-1].lstrip("_") == _SHARD_MAP_SUFFIX:
+                idx = 1 if d.split(".")[-1] == "_shard_map" else 0
+                if len(node.args) > idx:
+                    sub, name = self._resolve_subject(node.args[idx])
+                    if sub is not None and name:
+                        roots.add(name)
+            for suffix, positions in _CTRL_FLOW_BODY_ARGS.items():
+                if d == suffix or d.endswith("." + suffix):
+                    for pos in positions:
+                        if len(node.args) > pos:
+                            sub, name = self._resolve_subject(node.args[pos])
+                            if sub is not None and name:
+                                roots.add(name)
+        if self.relpath in ALL_TRACED_MODULES:
+            for stmt in self.tree.body:
+                if isinstance(stmt, ast.FunctionDef):
+                    roots.add(stmt.name)
+        return roots
+
+    def _reach(self, roots: set[str]) -> set[str]:
+        """Transitive closure over same-module calls by bare name."""
+        seen: set[str] = set()
+        work = [r for r in roots if r in self.defs]
+        while work:
+            name = work.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            fn = self.defs[name]
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = dotted(node.func)
+                    if callee and "." not in callee and callee in self.defs \
+                            and callee not in seen:
+                        work.append(callee)
+        return seen
+
+    def traced_defs(self) -> list[tuple[str, ast.AST, bool]]:
+        """(name, def, is_direct_root) for every traced function."""
+        out = []
+        for name in sorted(self.traced):
+            out.append((name, self.defs[name], name in self.traced_roots))
+        return out
+
+
+def _finding(module: Module, rule: str, node: ast.AST,
+             message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    snippet = (module.lines[line - 1].strip()
+               if 0 < line <= len(module.lines) else "")
+    return Finding(rule=rule, path=module.relpath, line=line,
+                   col=getattr(node, "col_offset", 0) + 1,
+                   message=message, snippet=snippet)
+
+
+def _params(fn: ast.AST, static: tuple[int, ...] = ()) -> list[str]:
+    """Positional parameter names minus static argnum positions and
+    self/cls."""
+    args = fn.args
+    names = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return [n for i, n in enumerate(names) if i not in static]
+
+
+def _annotation_is_scalar(node: Optional[ast.AST]) -> bool:
+    """True for annotations naming Python scalars (`int`, `float`,
+    `bool | None`, `Optional[int]`, `Config`): the parameter is a
+    trace-time static, never a tracer."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return True
+        if isinstance(node.value, str):  # string annotation
+            try:
+                return _annotation_is_scalar(
+                    ast.parse(node.value, mode="eval").body)
+            except SyntaxError:
+                return False
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in _SCALAR_ANNOTATIONS
+    if isinstance(node, ast.Attribute):
+        return node.attr in _SCALAR_ANNOTATIONS
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return (_annotation_is_scalar(node.left)
+                and _annotation_is_scalar(node.right))
+    if isinstance(node, ast.Subscript):
+        d = dotted(node.value) or ""
+        if d.rsplit(".", 1)[-1] == "Optional":
+            return _annotation_is_scalar(node.slice)
+    return False
+
+
+def _array_params(fn: ast.AST, static: tuple[int, ...] = ()) -> set[str]:
+    """Parameters that could plausibly be tracers: positional params
+    minus static argnums, static-by-convention names (cfg/mesh/axis),
+    scalar-annotated params, and params rebound in the body (a rebound
+    name holds a locally computed value; flagging it trades recall for
+    precision)."""
+    args = fn.args
+    all_pos = list(args.posonlyargs) + list(args.args)
+    names: set[str] = set()
+    for i, a in enumerate(all_pos):
+        if i == 0 and a.arg in ("self", "cls"):
+            continue
+        if i in static or a.arg in STATIC_PARAM_NAMES:
+            continue
+        if _annotation_is_scalar(a.annotation):
+            continue
+        names.add(a.arg)
+    rebound = {n.id for n in ast.walk(fn)
+               if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+    return names - rebound
+
+
+def _is_identity_test(test: ast.AST) -> bool:
+    """`x is None` / `x is not None`: object-identity checks are static
+    structure, never data-dependent."""
+    return (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.IsNot)))
+
+
+# --------------------------------------------------------------------------
+# Rule 1: donation-aliasing
+# --------------------------------------------------------------------------
+
+def _is_fresh_memory(node: ast.AST) -> bool:
+    """asarray of a list/tuple literal (or comprehension) allocates fresh
+    host memory -- no aliasing possible."""
+    return isinstance(node, (ast.List, ast.Tuple, ast.ListComp))
+
+
+def _scalar_wrapped(parents: dict, node: ast.AST) -> bool:
+    """`float(np.asarray(x))` / `int(...)` reads one scalar out; nothing
+    retains the view."""
+    p = parents.get(node)
+    return (isinstance(p, ast.Call) and dotted(p.func) in ("int", "float")
+            and p.args and p.args[0] is node)
+
+
+def _parent_map(root: ast.AST) -> dict:
+    parents: dict = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def rule_donation_aliasing(module: Module) -> list[Finding]:
+    """Both directions of the PR-2 bug class.
+
+    Scope A (save side): in snapshot/save functions of the checkpoint /
+    artifact / backend copy-discipline surface, a zero-copy
+    ``np.asarray`` / ``jnp.asarray`` of anything that could be a device
+    buffer silently aliases live donated state (on the CPU platform
+    asarray of a device buffer is zero-copy and the donating step fns
+    reuse the buffer on the next call).  Required idiom: ``np.array``
+    (copy) -- or an explicit allow() naming why the source is host-owned.
+
+    Scope A2 (restore side): ``jax.device_put(np.asarray(...))`` hands
+    XLA a buffer it does not own; restored leaves feeding donating jits
+    must be device copies (``jnp.array``).
+
+    Scope B (read-after-donate): a variable passed in a donated argnum
+    position is dead -- any later read in the same block observes a
+    buffer XLA has already reused."""
+    out: list[Finding] = []
+    if module.in_copy_scope():
+        parents = _parent_map(module.tree)
+        for name, fn in module.defs.items():
+            if not COPY_FUNC_RE.search(name):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d in _ASARRAY_NAMES and node.args \
+                        and not _is_fresh_memory(node.args[0]) \
+                        and not _scalar_wrapped(parents, node):
+                    out.append(_finding(
+                        module, "donation-aliasing", node,
+                        f"zero-copy {d}() in snapshot path {name}(): on "
+                        "the CPU platform this aliases a live (possibly "
+                        "donated) buffer -- copy with np.array(), or "
+                        "allow() with the reason the source is "
+                        "host-owned"))
+                elif d is not None and d.endswith("array") and any(
+                        kw.arg == "copy"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False
+                        for kw in node.keywords):
+                    out.append(_finding(
+                        module, "donation-aliasing", node,
+                        f"{d}(copy=False) in snapshot path {name}(): "
+                        "explicit no-copy of possibly-donated state"))
+    # Scope A2 + B apply everywhere in the package.
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and \
+                (dotted(node.func) or "").endswith("device_put") \
+                and node.args and isinstance(node.args[0], ast.Call) \
+                and dotted(node.args[0].func) in _ASARRAY_NAMES:
+            out.append(_finding(
+                module, "donation-aliasing", node,
+                "device_put(asarray(...)): zero-copy placement feeds "
+                "XLA a buffer it does not own; use jnp.array (device "
+                "copy) before placement"))
+    out.extend(_read_after_donate(module))
+    return out
+
+
+def _read_after_donate(module: Module) -> list[Finding]:
+    """Linear scan per block: after `f(x, ...)` where f donates argnum i
+    and arg i is a bare Name, a later load of that name (without an
+    intervening rebind) reads a buffer XLA already reused."""
+    if not module.donating_defs:
+        return []
+    out: list[Finding] = []
+    for fname, fn in module.defs.items():
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for block in _blocks(fn):
+            dead: dict[str, tuple[ast.AST, str]] = {}
+            for stmt in block:
+                # A rebind resurrects the name (typically `x = step(x)`).
+                loads, stores, donations = _stmt_accesses(module, stmt)
+                for name_node in loads:
+                    if name_node.id in dead:
+                        callee = dead[name_node.id][1]
+                        out.append(_finding(
+                            module, "donation-aliasing", name_node,
+                            f"read of {name_node.id!r} after it was "
+                            f"donated to {callee}() (donate_argnums): "
+                            "the buffer may already be reused by XLA"))
+                        del dead[name_node.id]  # report once per block
+                for var, (node, callee) in donations.items():
+                    dead[var] = (node, callee)
+                for s in stores:
+                    dead.pop(s, None)
+    return out
+
+
+def _blocks(fn: ast.AST):
+    """Statement lists to scan linearly (function body + nested block
+    bodies, each scanned independently -- loop re-entry is not modeled,
+    keeping the rule conservative)."""
+    for node in ast.walk(fn):
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(node, field, None)
+            if isinstance(block, list) and block and \
+                    isinstance(block[0], ast.stmt):
+                yield block
+
+
+def _stmt_accesses(module: Module, stmt: ast.stmt):
+    """(loads, stores, donations) of one statement.  A call to a known
+    donating def with a bare-Name arg in a donated position marks that
+    name donated; Name loads *inside* the donating call itself are the
+    donation, not a stale read."""
+    donations: dict[str, tuple[ast.AST, str]] = {}
+    donated_nodes: set[int] = set()
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted(node.func)
+        if callee in module.donating_defs:
+            for i in module.donating_defs[callee]:
+                if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                    donations[node.args[i].id] = (node, callee)
+                    donated_nodes.add(id(node.args[i]))
+    loads, stores = [], set()
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Store):
+                stores.add(node.id)
+            elif isinstance(node.ctx, ast.Load) and \
+                    id(node) not in donated_nodes:
+                loads.append(node)
+    return loads, stores, donations
+
+
+# --------------------------------------------------------------------------
+# Rule 2: dtype-discipline
+# --------------------------------------------------------------------------
+
+def rule_dtype_discipline(module: Module) -> list[Finding]:
+    """SoA state columns and mail-ring lanes stay inside the declared
+    dtype budget: array constructors in traced modules must name a dtype
+    (the host default is float64/int64 -- the implicit-int64-on-device
+    class), the named dtype must be in the allowed set, and bare Python
+    float literals must not enter traced arithmetic (weak-type promotion
+    retypes the whole expression)."""
+    if not module.in_traced_scope():
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf in _CONSTRUCTORS and (
+                d == leaf or any(d.startswith(p) and d == p + leaf
+                                 for p in _CONSTRUCTOR_PREFIXES)):
+            dtype_node = _constructor_dtype(node, _CONSTRUCTORS[leaf])
+            if dtype_node is None:
+                out.append(_finding(
+                    module, "dtype-discipline", node,
+                    f"{d}() without an explicit dtype: defaults to "
+                    "float64/int64 on host (implicit int64 on device) -- "
+                    "name a dtype from the declared set"))
+            else:
+                _check_dtype_value(module, node, dtype_node, out)
+        elif leaf == "astype" and node.args:
+            _check_dtype_value(module, node, node.args[0], out)
+        elif leaf in ("float64", "float16", "bfloat16", "complex64",
+                      "complex128") and d != leaf:
+            out.append(_finding(
+                module, "dtype-discipline", node,
+                f"{d}() cast: {leaf} is outside the declared SoA dtype "
+                "set (uint8/int32/int64 columns; float32 draws)"))
+    out.extend(_float_literal_arith(module))
+    return out
+
+
+def _constructor_dtype(call: ast.Call, pos: Optional[int]):
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _check_dtype_value(module: Module, site: ast.AST, dtype_node: ast.AST,
+                       out: list[Finding]) -> None:
+    canon = module.canon_dtype(dtype_node)
+    if canon is None:
+        return  # dynamic dtype expression -- not statically checkable
+    if canon not in ALLOWED_DTYPES:
+        out.append(_finding(
+            module, "dtype-discipline", site,
+            f"dtype {canon} is outside the declared SoA set "
+            f"({', '.join(sorted(ALLOWED_DTYPES))})"))
+
+
+def _float_literal_arith(module: Module) -> list[Finding]:
+    """Bare float literal combined arithmetically with a traced-function
+    parameter: the weak f32 promotion silently retypes integer lanes."""
+    out: list[Finding] = []
+    for name, fn, _ in module.traced_defs():
+        params = _array_params(fn)
+        if not params:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.BinOp):
+                continue
+            sides = (node.left, node.right)
+            lit = next((s for s in sides
+                        if isinstance(s, ast.Constant)
+                        and isinstance(s.value, float)), None)
+            other = sides[1] if lit is sides[0] else sides[0]
+            if lit is not None and isinstance(other, ast.Name) \
+                    and other.id in params:
+                out.append(_finding(
+                    module, "dtype-discipline", node,
+                    f"bare Python float {lit.value!r} in traced "
+                    f"arithmetic with parameter {other.id!r} of "
+                    f"{name}(): weak-type promotion retypes the lane"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 3: trace-purity
+# --------------------------------------------------------------------------
+
+_PURITY_CALL_PREFIXES = ("time.", "np.random.", "numpy.random.")
+
+
+def rule_trace_purity(module: Module) -> list[Finding]:
+    """No host nondeterminism inside traced code: wall clocks, host RNG,
+    tracer->host coercions (.item(), int(tracer)), and data-dependent
+    Python branches all either fail to trace or -- worse -- trace once and
+    silently freeze a value the next call won't recompute."""
+    if not (module.in_traced_scope() or module.traced):
+        return []
+    has_stdlib_random = any(
+        isinstance(s, ast.Import) and any(a.name == "random"
+                                          for a in s.names)
+        for s in module.tree.body)
+    out: list[Finding] = []
+    for name, fn, is_root in module.traced_defs():
+        static_idx: tuple[int, ...] = ()
+        for site in module.jit_sites:
+            if site.subject_name == name and site.static_argnums:
+                static_idx = site.static_argnums
+        array_params = _array_params(fn, static_idx)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if any(d.startswith(p) for p in _PURITY_CALL_PREFIXES):
+                    out.append(_finding(
+                        module, "trace-purity", node,
+                        f"{d}() inside traced {name}(): host "
+                        "nondeterminism freezes into the trace -- use "
+                        "jax.random with a threaded key (utils/rng)"))
+                elif has_stdlib_random and d.startswith("random."):
+                    out.append(_finding(
+                        module, "trace-purity", node,
+                        f"stdlib {d}() inside traced {name}(): host RNG "
+                        "is invisible to the tracer"))
+                elif d.endswith(".item"):
+                    out.append(_finding(
+                        module, "trace-purity", node,
+                        f".item() inside traced {name}(): forces a "
+                        "device sync / fails under the tracer"))
+                elif d in ("int", "float", "bool") and node.args and \
+                        _mentions(node.args[0], array_params):
+                    out.append(_finding(
+                        module, "trace-purity", node,
+                        f"{d}(<traced value>) inside {name}(): coercing "
+                        "a tracer to a Python scalar fails to trace (the "
+                        "int(tracer) class)"))
+            elif is_root and isinstance(node, (ast.If, ast.While)) and \
+                    not _is_identity_test(node.test) and \
+                    _mentions(node.test, array_params):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                out.append(_finding(
+                    module, "trace-purity", node,
+                    f"data-dependent Python `{kind}` on traced "
+                    f"parameter(s) of {name}(): branches on tracers "
+                    "fail to trace -- use lax.cond/jnp.where"))
+    return out
+
+
+def _mentions(node: ast.AST, names: set[str]) -> bool:
+    if not names:
+        return False
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+# --------------------------------------------------------------------------
+# Rule 4: donation-coverage
+# --------------------------------------------------------------------------
+
+def rule_donation_coverage(module: Module) -> list[Finding]:
+    """Hot-path jits carrying state must donate it: without
+    donate_argnums every window step holds two copies of the SoA state
+    live (the 1e9-node memory budget assumes one) and XLA inserts a
+    defensive copy on the update."""
+    if not module.in_donation_scope():
+        return []
+    out: list[Finding] = []
+    for site in module.jit_sites:
+        if site.donate is not None or site.subject is None:
+            continue
+        params = _params(site.subject, site.static_argnums)
+        stateful = [p for p in params if p in STATE_PARAM_NAMES]
+        if stateful:
+            out.append(_finding(
+                module, "donation-coverage", site.node,
+                f"jit of {site.subject_name or '<callable>'}() carries "
+                f"state parameter(s) {', '.join(stateful)} but declares "
+                "no donate_argnums: the step holds two live copies of "
+                "the SoA state and XLA copies on update"))
+    return out
+
+
+RULES = {
+    "donation-aliasing": rule_donation_aliasing,
+    "dtype-discipline": rule_dtype_discipline,
+    "trace-purity": rule_trace_purity,
+    "donation-coverage": rule_donation_coverage,
+}

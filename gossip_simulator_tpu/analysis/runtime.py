@@ -1,0 +1,252 @@
+"""Runtime contract checkers: the compile-budget watcher.
+
+Retrace regressions are the silent perf killer on the sharded engines: a
+closure-captured Python scalar, a weak-typed literal, or a shape leak
+turns one compile per entrypoint into one per *window*, and nothing
+fails -- the run just gets slower.  ``CompileWatch`` captures JAX's own
+compile/trace logging so per-entrypoint compile counts can be pinned in
+the committed ``COMPILE_BUDGET.json`` (scripts/check_compile_budget.py)
+and asserted in CI, with the guilty call site named on regression.
+
+Mechanics (validated on this jax): under ``jax_log_compiles`` the
+"Compiling <name> ..." record fires on every tracing-cache miss, BEFORE
+the persistent-compilation-cache lookup -- so counts are stable whether
+the executable itself comes from the cache or not.  With
+``jax_explain_cache_misses`` each miss also logs a "TRACING CACHE MISS
+at <file>:<line>" record explaining *why* (new avals vs changed
+constants), which is what names the guilty call site.
+
+JAX is imported lazily: importing this module (e.g. via the analysis
+package's CLI) stays JAX-free.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+from typing import Optional
+
+BUDGET_VERSION = 1
+
+_COMPILE_RE = re.compile(r"^Compiling ([^\s]+)")
+_TRACE_RE = re.compile(r"^Finished tracing \+ transforming ([^\s]+) ")
+_MISS_RE = re.compile(r"TRACING CACHE MISS at (.+?) because:\s*(.*)",
+                      re.DOTALL)
+_AVAL_RE = re.compile(r"ShapedArray\([^)]*\)")
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self, watch: "CompileWatch"):
+        super().__init__(level=logging.DEBUG)
+        self._watch = watch
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._watch._ingest(record.getMessage())
+        except Exception:  # a watcher must never break the watched run
+            pass
+
+
+class CompileWatch:
+    """Context manager recording per-entrypoint compile events.
+
+    with CompileWatch() as watch:
+        run_workload()
+    watch.counts()   -> {entrypoint: compile count}
+    watch.avals      -> {entrypoint: [[aval, ...] per compile]}
+    watch.misses     -> [(call site, reason), ...]
+    """
+
+    def __init__(self):
+        self.compiles: list[tuple[str, list[str]]] = []
+        self.traces: list[str] = []
+        self.misses: list[tuple[str, str]] = []
+        self._handler: Optional[logging.Handler] = None
+        self._saved: dict[str, object] = {}
+
+    # -- capture -----------------------------------------------------------
+    def _ingest(self, msg: str) -> None:
+        m = _COMPILE_RE.match(msg)
+        if m:
+            self.compiles.append((m.group(1), _AVAL_RE.findall(msg)))
+            return
+        m = _TRACE_RE.match(msg)
+        if m:
+            self.traces.append(m.group(1))
+            return
+        m = _MISS_RE.search(msg)
+        if m:
+            self.misses.append((m.group(1).strip(),
+                                " ".join(m.group(2).split())))
+
+    # -- context -----------------------------------------------------------
+    def __enter__(self) -> "CompileWatch":
+        import jax
+
+        for knob in ("jax_log_compiles", "jax_explain_cache_misses"):
+            try:
+                self._saved[knob] = getattr(jax.config, knob)
+                jax.config.update(knob, True)
+            except (AttributeError, ValueError):
+                pass
+        self._handler = _CaptureHandler(self)
+        logger = logging.getLogger("jax")
+        self._saved["_level"] = logger.level
+        # The compile/miss records are WARNING-level under the flags;
+        # leave the logger's effective level alone beyond ensuring they
+        # propagate to our handler.
+        if logger.level > logging.WARNING:
+            logger.setLevel(logging.WARNING)
+        logger.addHandler(self._handler)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        logger = logging.getLogger("jax")
+        if self._handler is not None:
+            logger.removeHandler(self._handler)
+        logger.setLevel(self._saved.pop("_level", logging.NOTSET))
+        for knob, val in self._saved.items():
+            try:
+                jax.config.update(knob, val)
+            except (AttributeError, ValueError):
+                pass
+
+    # -- reports -----------------------------------------------------------
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for name, _ in self.compiles:
+            out[name] = out.get(name, 0) + 1
+        return out
+
+    @property
+    def avals(self) -> dict[str, list[list[str]]]:
+        out: dict[str, list[list[str]]] = {}
+        for name, av in self.compiles:
+            out.setdefault(name, []).append(av)
+        return out
+
+    def report(self) -> dict:
+        return {"entrypoints": self.counts(), "avals": self.avals,
+                "misses": [{"site": s, "reason": r}
+                           for s, r in self.misses]}
+
+
+# --------------------------------------------------------------------------
+# Budget file
+# --------------------------------------------------------------------------
+
+def default_budget_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        "COMPILE_BUDGET.json")
+
+
+def load_budget(path: Optional[str] = None) -> Optional[dict]:
+    path = path or default_budget_path()
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != BUDGET_VERSION:
+        raise ValueError(
+            f"compile budget {path}: unsupported version "
+            f"{data.get('version')!r}")
+    return data
+
+
+def budget_id(path: Optional[str] = None) -> str:
+    """Content id of the active compile budget ("none" when absent) --
+    stamped into resolved_gates / run artifacts so compare_runs can name
+    a stale budget when fingerprints diverge."""
+    path = path or default_budget_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return "none"
+    canon = json.dumps(data, sort_keys=True, separators=(",", ":"))
+    return "cb-" + hashlib.sha256(canon.encode()).hexdigest()[:12]
+
+
+def _first_aval_diff(avals: list[list[str]],
+                     expected: int) -> Optional[str]:
+    """First argument position where consecutive compiles of the same
+    entrypoint disagree on avals (None = every compile saw identical
+    avals: the retrace was forced by a changed constant or closure
+    capture, not by shapes)."""
+    for i in range(1, len(avals)):
+        prev, cur = avals[i - 1], avals[i]
+        for pos in range(max(len(prev), len(cur))):
+            a = prev[pos] if pos < len(prev) else "<absent>"
+            b = cur[pos] if pos < len(cur) else "<absent>"
+            if a != b:
+                return f"compile {i}, arg {pos}: {a} -> {b}"
+    return None
+
+
+def compare_budget(expected: dict[str, int], report: dict) -> list[dict]:
+    """Violations of a combo's entrypoint budget.
+
+    Over-budget and unknown entrypoints are failures; an under-budget
+    entrypoint (fewer compiles than pinned, e.g. after a refactor merges
+    two programs) is reported as kind="under" so the caller can warn and
+    suggest --update instead of failing."""
+    observed = report.get("entrypoints", {})
+    avals = report.get("avals", {})
+    misses = report.get("misses", [])
+    out: list[dict] = []
+    for name, got in sorted(observed.items()):
+        want = expected.get(name)
+        if want is None:
+            out.append({
+                "kind": "unknown", "entrypoint": name,
+                "expected": 0, "observed": got,
+                "detail": "entrypoint not in COMPILE_BUDGET.json -- new "
+                          "jit program; re-pin with --update if intended",
+                "misses": _misses_for(misses, name)})
+        elif got > want:
+            diff = _first_aval_diff(avals.get(name, [[]]), want)
+            detail = (f"first differing avals: {diff}" if diff else
+                      "identical avals across compiles: retrace forced "
+                      "by a changed constant/closure capture (the "
+                      "captured-Python-scalar class)")
+            out.append({
+                "kind": "over", "entrypoint": name,
+                "expected": want, "observed": got, "detail": detail,
+                "misses": _misses_for(misses, name)})
+    for name, want in sorted(expected.items()):
+        got = observed.get(name, 0)
+        if got < want:
+            out.append({
+                "kind": "under", "entrypoint": name,
+                "expected": want, "observed": got,
+                "detail": "fewer compiles than pinned (merged/removed "
+                          "program?) -- re-pin with --update",
+                "misses": []})
+    return out
+
+
+def _misses_for(misses: list[dict], name: str) -> list[dict]:
+    """Cache-miss explanations plausibly about `name` (jax logs the
+    fn name inside the reason text); falls back to all misses so the
+    guilty call site is never dropped."""
+    short = name.split("(")[-1].rstrip(")")
+    mine = [m for m in misses
+            if short and (short in m.get("reason", "")
+                          or short in m.get("site", ""))]
+    return mine or misses
+
+
+def format_violation(combo: str, v: dict) -> str:
+    lines = [f"[{combo}] {v['entrypoint']}: "
+             f"expected {v['expected']} compile(s), "
+             f"observed {v['observed']} ({v['kind']})",
+             f"    {v['detail']}"]
+    for m in v.get("misses", [])[:4]:
+        lines.append(f"    cache miss at {m['site']}: {m['reason']}")
+    return "\n".join(lines)

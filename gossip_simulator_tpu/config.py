@@ -659,6 +659,15 @@ class Config:
         # behind a matching workload-shape key), so compare_runs names a
         # mismatch here as the first divergence suspect.
         gates["tuning_table"] = self.tuning_entry_resolved
+        # The active compile-budget pin ("none" when absent): the budget
+        # cannot move a trajectory, but a RETRACE regression it would
+        # have caught can hide behind one -- so compare_runs names a
+        # stale budget id right next to the tuning-table id when
+        # fingerprints diverge.  Never raises (budget_id degrades to
+        # "none"); pure-stdlib, so validate()'s no-jax rule holds.
+        from gossip_simulator_tpu.analysis import runtime as _rt
+
+        gates["compile_budget"] = _rt.budget_id()
         return gates
 
     @property

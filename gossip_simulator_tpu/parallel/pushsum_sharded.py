@@ -281,7 +281,7 @@ def make_seed_fn(cfg: Config, mesh):
         return st
 
     return jax.jit(_shard_map(mesh, seed_shard, in_specs=(specs, P()),
-                              out_specs=specs))
+                              out_specs=specs), donate_argnums=(0,))
 
 
 def make_run_to_coverage_fn(cfg: Config, mesh, telemetry: bool = False):

@@ -49,7 +49,11 @@ def save(ckpt_dir: str, window: int, tree: dict[str, Any], stats: Stats,
     path = os.path.join(ckpt_dir, f"{prefix}_{window:08d}.npz")
     with _trace.span("checkpoint.save", cat="io", prefix=prefix,
                      window=window):
-        arrays = {k: np.asarray(v) for k, v in tree.items()}
+        # np.array (COPY), not np.asarray: on the CPU platform asarray of
+        # a device buffer is zero-copy, and the donating window fns reuse
+        # the buffer on the next step -- the "snapshot" would silently
+        # track live state until savez reads it (the PR-2 aliasing bug).
+        arrays = {k: np.array(v) for k, v in tree.items()}
         tmp = path + ".tmp"
         # np.savez appends ".npz" to names without it -- write under the
         # real suffix structure by handing it a file object.

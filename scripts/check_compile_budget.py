@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Assert per-entrypoint compile counts against COMPILE_BUDGET.json.
+
+Runs the tier-1-sized fingerprint workload (tests/test_multirumor.py's
+FP_COMBOS convention) for all four engine combos, each in its OWN
+subprocess with the 8-fake-device CPU env (utils/jaxsetup.forced_cpu_env)
+so tracing-cache state never leaks between combos, and compares the
+observed per-entrypoint compile counts -- captured by
+analysis.runtime.CompileWatch under jax_log_compiles -- to the committed
+pin.
+
+A retrace regression (the closure-captured-Python-scalar class) fails
+with the entrypoint named, expected vs observed counts, the first
+differing avals, and the TRACING CACHE MISS call site jax explains.
+
+    python scripts/check_compile_budget.py            # check all combos
+    python scripts/check_compile_budget.py --combo jax_event
+    python scripts/check_compile_budget.py --update   # re-pin the budget
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gossip_simulator_tpu.analysis import runtime as rt  # noqa: E402
+from gossip_simulator_tpu.utils import jaxsetup  # noqa: E402
+
+# The tier-1-sized fingerprint workload (tests/test_multirumor.py BASE /
+# FP_COMBOS): small enough for CI, every jitted entrypoint of each engine
+# exercised (init, overlay windows, seed, gossip windows to coverage).
+BASE = dict(graph="kout", fanout=6, seed=3, crashrate=0.01,
+            coverage_target=0.95, progress=False)
+COMBOS = {
+    "jax_event": dict(n=3000, backend="jax", engine="event"),
+    "jax_ring": dict(n=3000, backend="jax", engine="ring"),
+    "sharded_event": dict(n=4000, backend="sharded", engine="event"),
+    "sharded_ring": dict(n=4000, backend="sharded", engine="ring"),
+}
+
+_MARK = "COMPILE_BUDGET_REPORT_JSON:"
+
+
+def run_child(combo: str) -> dict:
+    """One combo's workload in a fresh interpreter; returns its
+    CompileWatch report."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", combo],
+        env=jaxsetup.forced_cpu_env(8), cwd=REPO,
+        capture_output=True, text=True, timeout=1200)
+    for line in proc.stdout.splitlines():
+        if line.startswith(_MARK):
+            return json.loads(line[len(_MARK):])
+    raise SystemExit(
+        f"[{combo}] child produced no report (exit {proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout[-2000:]}\n"
+        f"--- stderr ---\n{proc.stderr[-2000:]}")
+
+
+def child_main(combo: str) -> int:
+    jaxsetup.setup()
+    from gossip_simulator_tpu.backends import make_stepper
+    from gossip_simulator_tpu.config import Config
+
+    cfg = Config(**BASE, **COMBOS[combo]).validate()
+    with rt.CompileWatch() as watch:
+        s = make_stepper(cfg)
+        s.init()
+        while not s.overlay_window()[2]:
+            pass
+        s.seed()
+        for _ in range(400):
+            st = s.gossip_window()
+            if st.coverage >= cfg.coverage_target or s.exhausted:
+                break
+    print(_MARK + json.dumps(watch.report()))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--combo", action="append", choices=sorted(COMBOS),
+                    help="subset of combos (default: all four)")
+    ap.add_argument("--budget", default=None,
+                    help="budget file (default: COMPILE_BUDGET.json)")
+    ap.add_argument("--update", action="store_true",
+                    help="re-pin the budget from observed counts")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable result on stdout")
+    ap.add_argument("--child", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return child_main(args.child)
+
+    path = args.budget or rt.default_budget_path()
+    combos = args.combo or sorted(COMBOS)
+    reports = {c: run_child(c) for c in combos}
+
+    if args.update:
+        budget = rt.load_budget(path) if os.path.exists(path) else None
+        data = budget or {"version": rt.BUDGET_VERSION,
+                          "workload": {"base": BASE, "combos": COMBOS},
+                          "combos": {}}
+        for c, rep in reports.items():
+            data["combos"][c] = {"entrypoints": rep["entrypoints"]}
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"compile budget re-pinned -> {path} "
+              f"(id {rt.budget_id(path)})")
+        return 0
+
+    budget = rt.load_budget(path)
+    if budget is None:
+        print(f"no compile budget at {path}; run with --update to pin",
+              file=sys.stderr)
+        return 2
+
+    failures: list[str] = []
+    result = {"budget_id": rt.budget_id(path), "combos": {}}
+    for c, rep in reports.items():
+        expected = budget["combos"].get(c, {}).get("entrypoints")
+        if expected is None:
+            failures.append(f"[{c}] combo missing from {path} -- "
+                            "re-pin with --update")
+            result["combos"][c] = {"violations": [], "missing": True}
+            continue
+        violations = rt.compare_budget(expected, rep)
+        result["combos"][c] = {"violations": violations,
+                               "observed": rep["entrypoints"]}
+        for v in violations:
+            msg = rt.format_violation(c, v)
+            if v["kind"] == "under":
+                print("WARNING: " + msg, file=sys.stderr)
+            else:
+                failures.append(msg)
+
+    if args.as_json:
+        result["ok"] = not failures
+        json.dump(result, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    if failures:
+        print(f"compile budget (id {rt.budget_id(path)}): "
+              f"{len(failures)} violation(s)", file=sys.stderr)
+        for msg in failures:
+            print(msg, file=sys.stderr)
+        return 1
+    if not args.as_json:
+        print(f"compile budget OK (id {rt.budget_id(path)}): "
+              + ", ".join(f"{c}={sum(reports[c]['entrypoints'].values())} "
+                          "compiles" for c in combos))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,12 +142,31 @@ def compare(a: dict, b: dict, timing_tolerance: float,
     fb = rb.get("fingerprint")
     report["fingerprint"] = {"a": fa, "b": fb,
                              "match": fa == fb and fa is not None}
+    # The two attribution ids ride in every report (not just on
+    # mismatch): CI consumers key caching and triage off them.
+    report["tuning_table"] = {"a": ga.get("tuning_table"),
+                              "b": gb.get("tuning_table")}
+    report["compile_budget"] = {"a": ga.get("compile_budget"),
+                                "b": gb.get("compile_budget")}
     if fa == fb and fa is not None:
         lines.append(f"fingerprint: MATCH {fa} "
                      f"(basis {ra.get('fingerprint_basis')})")
     else:
         diverged = True
         lines.append(f"fingerprint: DIVERGED {fa} vs {fb}")
+        # A STALE COMPILE BUDGET outranks even the tuning table as the
+        # first suspect: two runs checked against different budget pins
+        # can differ in which retrace regressions were allowed to pass,
+        # so the divergence may be a retrace-class bug one side's budget
+        # would have caught (scripts/check_compile_budget.py).
+        cba = ga.get("compile_budget")
+        cbb = gb.get("compile_budget")
+        if cba != cbb:
+            report["compile_budget_mismatch"] = [cba, cbb]
+            lines.append(f"  compile-budget mismatch: {cba} vs {cbb} -- "
+                         "a stale budget pin is the first suspect; "
+                         "re-pin with scripts/check_compile_budget.py "
+                         "--update and re-compare")
         # A tuning-table mismatch is the FIRST suspect: two runs resolving
         # different tuned-constant entries are EXPECTED to stay
         # trajectory-identical (every persisted tunable passed the
