@@ -115,7 +115,7 @@ def pool_retry(fn, *args, name: str = "", retries: int = 3,
 # every dated skip record so a BENCH_SELF_rNN.json names WHICH session
 # failed to reach hardware, and diffed against queued_since below to
 # render how many consecutive sessions each queued row has waited.
-SESSION = "r16"
+SESSION = "r18"
 
 
 def session_number(tag: str) -> int:
@@ -194,6 +194,13 @@ QUEUED_HARDWARE_ROWS = (
              "(the traffic matrix + shard/group panels' recording cost "
              "over real ICI; the CPU spatial_overhead_1m twin bounds "
              "only the single-chip scatter cost)"},
+    {"row": "megakernel_50m_twins", "queued_since": "r18",
+     "capture": "capture_megakernel_twins",
+     "what": "50M -phase2-kernel xla-vs-pallas same-seed wall-clock "
+             "twins (event, R=16, pushsum), each reported as ns/message "
+             "against ROOFLINE.json's per-term floor (the fused pass is "
+             "parity-pinned bit-identical on CPU but unmeasured on "
+             "device)"},
 )
 
 
@@ -920,6 +927,77 @@ def capture_deliver_kernel_twins(detail: dict, seed: int) -> None:
             detail[f"{name}_{kern}"] = row
 
 
+def capture_megakernel_twins(detail: dict, seed: int) -> None:
+    """-phase2-kernel A/B twins at scale (ISSUE 18): the 50M suite shape,
+    its R=16 sibling, and the 50M pushsum shape, each run with the fused
+    emit->route->deliver megakernel vs the XLA chain it replaces at the
+    SAME n/graph/seed.  Interpret-mode CI already pins bit-identical
+    trajectories (tests/test_megakernel.py), so these rows exist to
+    record the measured wall-clock delta AND the achieved ns/message
+    against ROOFLINE.json's per-term floor; an unreachable axon pool
+    leaves dated skip records that re-queue the pair."""
+    base = Config(n=50_000_000, fanout=6, graph="kout", backend="jax",
+                  seed=seed, crashrate=0.0, coverage_target=0.95,
+                  max_rounds=3000, progress=False).validate()
+    push = Config(n=50_000_000, fanout=6, graph="kout", backend="jax",
+                  seed=seed, crashrate=0.0, droprate=0.0, model="pushsum",
+                  coverage_target=0.9, max_rounds=3000,
+                  progress=False).validate()
+    for name, cfg in (("megakernel_50m", base),
+                      ("megakernel_50m_r16", base.replace(rumors=16)),
+                      ("megakernel_50m_pushsum", push)):
+        for kern in ("xla", "pallas"):
+            row = pool_retry(
+                _bench_backend,
+                cfg.replace(phase2_kernel=kern).validate(),
+                name=f"{name}_{kern}")
+            detail[f"{name}_{kern}"] = row
+
+
+def capture_megakernel_interpret_parity(detail: dict, seed: int) -> None:
+    """Measured CPU-scale -phase2-kernel twin (ISSUE 18): interpret mode
+    is the correctness surface, not a fast path, so this row records the
+    measured cost of that surface next to a live trajectory-equality
+    verdict -- the bench sibling of ROOFLINE.json's interpret evidence
+    row.  The speed question stays queued (megakernel_50m_twins)."""
+    import hashlib
+
+    from gossip_simulator_tpu.backends import make_stepper
+
+    base = Config(n=2_000, fanout=6, graph="kout", backend="jax",
+                  seed=seed, crashrate=0.01, coverage_target=0.95,
+                  max_rounds=3000, progress=False).validate()
+
+    def run(cfg):
+        s = make_stepper(cfg)
+        s.init()
+        while not s.overlay_window()[2]:
+            pass
+        s.seed()
+        rows = []
+        t0 = time.perf_counter()
+        for _ in range(400):
+            st = s.gossip_window()
+            rows.append((st.round, st.total_received, st.total_message,
+                         st.total_crashed, st.total_removed))
+            if st.coverage >= cfg.coverage_target or s.exhausted:
+                break
+        wall = time.perf_counter() - t0
+        fp = hashlib.sha256(
+            json.dumps(rows).encode()).hexdigest()[:16]
+        return wall, int(st.total_message), fp
+
+    xw, xm, xfp = run(base.replace(phase2_kernel="xla").validate())
+    pw, pm, pfp = run(base.replace(phase2_kernel="pallas").validate())
+    detail["megakernel_interpret_parity"] = {
+        "n": base.n, "mode": "interpret",
+        "xla_s": xw, "pallas_s": pw,
+        "xla_ns_per_message": xw / max(1, xm) * 1e9,
+        "pallas_ns_per_message": pw / max(1, pm) * 1e9,
+        "trajectory_match": xfp == pfp, "fingerprint": xfp,
+    }
+
+
 def capture_exchange_pipeline_twins(detail: dict, seed: int) -> None:
     """-exchange-pipeline A/B twins at scale (ISSUE 13): the 50M suite
     shape on the sharded backend (S = all attached chips), run with the
@@ -1018,8 +1096,9 @@ def _pallas_validation() -> dict:
         spec.loader.exec_module(mod)
         result = mod.run_checks()
         result["deliver_tpu"] = mod.run_deliver_checks()
+        result["megakernel_tpu"] = mod.run_megakernel_checks()
         # Merge, don't overwrite: the artifact also carries the dated
-        # CPU --interpret deliver verdict from CI hosts.
+        # CPU --interpret deliver/megakernel verdicts from CI hosts.
         mod._merge_out(os.path.join(here, "PALLAS_VALIDATION.json"), result)
         return result
     except Exception as e:  # record, don't kill the bench line
@@ -1223,6 +1302,9 @@ def main() -> int:
         # Spatial-telemetry on/off twins (ISSUE 16): panels must cost
         # <= 5% wall clock and leave the trajectory untouched.
         capture_spatial_overhead(result["detail"], args.seed)
+        # -phase2-kernel interpret-mode parity twin (ISSUE 18): measured
+        # cost of the CPU correctness surface + live trajectory match.
+        capture_megakernel_interpret_parity(result["detail"], args.seed)
         if jax.default_backend() == "tpu":
             # Distributional validation of the Pallas generators on real
             # hardware (interpret-mode CI can only check structure); also
@@ -1251,6 +1333,9 @@ def main() -> int:
             # -deliver-kernel fused-vs-XLA wall-clock twins at 50M/100M
             # (ISSUE 9; dated skips re-queue when the pool is down).
             capture_deliver_kernel_twins(result["detail"], args.seed)
+            # -phase2-kernel megakernel-vs-XLA twins at 50M (ISSUE 18):
+            # ns/message lands against ROOFLINE.json's per-term floor.
+            capture_megakernel_twins(result["detail"], args.seed)
             # 50M sharded exchange-pipeline double-vs-off twins
             # (ISSUE 13): the overlap win needs real ICI to show.
             capture_exchange_pipeline_twins(result["detail"], args.seed)

@@ -207,6 +207,19 @@ class Config:
     # on-device parity, else xla with a named reason
     # (deliver_kernel_fallback_reason).
     deliver_kernel: str = "auto"
+    # Phase-2 megakernel for the emit->route->deliver window (ROADMAP
+    # item 5, against the committed ROOFLINE.json floors): "pallas" runs
+    # the fused single-pass kernels (ops/pallas_megakernel -- emission
+    # mask/prefix/scatter, sharded receive landing, pushsum whole-slot
+    # drain, joint multi-rumor deposit; natively on TPU, interpret mode
+    # elsewhere; bit-identical, A/B-pinned by trajectory fingerprints);
+    # "xla" is the recorded multi-op chain and reproduces every prior
+    # trajectory bit-for-bit; "auto" picks pallas only when the one-shot
+    # TPU capability probe passes on-device parity, else xla with a
+    # named reason (phase2_kernel_fallback_reason).  Orthogonal to
+    # -deliver-kernel: where the megakernel engages it subsumes that
+    # gate's fused ops; everywhere else -deliver-kernel still applies.
+    phase2_kernel: str = "auto"
     # Exchange pipelining for the sharded backend (ROADMAP item 1):
     # "double" software-pipelines the per-chunk all_to_all at chunk
     # granularity -- the ring_append drain of batch j is deferred one
@@ -586,6 +599,36 @@ class Config:
         return pallas_deliver.tpu_unsupported()
 
     @property
+    def phase2_kernel_resolved(self) -> str:
+        """"xla" or "pallas" -- the megakernel twin of
+        deliver_kernel_resolved (same lazy policy: explicit "pallas"
+        raises the probe's named reason when this host cannot run the
+        fused passes, "auto" enables pallas only on TPU hosts that pass
+        the on-device parity probe; CPU interpret mode is a CI
+        correctness surface, not a fast path)."""
+        if self.phase2_kernel == "xla":
+            return "xla"
+        from gossip_simulator_tpu.ops import pallas_megakernel
+        if self.phase2_kernel == "pallas":
+            why = pallas_megakernel.kernel_unavailable_reason()
+            if why:
+                raise ValueError(
+                    f"-phase2-kernel pallas is unavailable on this host: "
+                    f"{why} (use -phase2-kernel xla or auto)")
+            return "pallas"
+        return "xla" if pallas_megakernel.tpu_unsupported() else "pallas"
+
+    @property
+    def phase2_kernel_fallback_reason(self) -> str:
+        """Non-empty iff `-phase2-kernel auto` resolved to xla: the
+        probe's named reason, surfaced by the driver so the fallback is
+        never silent."""
+        if self.phase2_kernel != "auto":
+            return ""
+        from gossip_simulator_tpu.ops import pallas_megakernel
+        return pallas_megakernel.tpu_unsupported()
+
+    @property
     def exchange_pipeline_resolved(self) -> str:
         """"off" or "double" -- resolved LAZILY (first model-build time,
         after jaxsetup.setup(); validate() must not import jax).
@@ -645,8 +688,13 @@ class Config:
                 gates["deliver_kernel"] = self.deliver_kernel_resolved
             except ValueError:
                 gates["deliver_kernel"] = "unavailable"
+            try:
+                gates["phase2_kernel"] = self.phase2_kernel_resolved
+            except ValueError:
+                gates["phase2_kernel"] = "unavailable"
         else:
             gates["deliver_kernel"] = None
+            gates["phase2_kernel"] = None
         # Exchange pipelining only exists on the sharded backend's
         # routed path; everywhere else there is no exchange to overlap.
         gates["exchange_pipeline"] = (
@@ -857,6 +905,10 @@ class Config:
             raise ValueError(
                 f"deliver_kernel must be auto|xla|pallas, "
                 f"got {self.deliver_kernel!r}")
+        if self.phase2_kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"phase2_kernel must be auto|xla|pallas, "
+                f"got {self.phase2_kernel!r}")
         if self.exchange_pipeline not in ("auto", "off", "double"):
             raise ValueError(
                 f"exchange_pipeline must be auto|off|double, "
@@ -1251,6 +1303,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    default=d.deliver_kernel,
                    help="mailbox delivery kernel: pallas fuses the "
                         "sort/rank/scatter chain into one pass "
+                        "(bit-identical, A/B-pinned); xla reproduces "
+                        "prior trajectories bit-for-bit; auto = pallas "
+                        "only when the TPU capability probe passes, else "
+                        "xla with a named reason")
+    p.add_argument("-phase2-kernel", "--phase2-kernel",
+                   dest="phase2_kernel", choices=("auto", "xla", "pallas"),
+                   default=d.phase2_kernel,
+                   help="phase-2 megakernel: pallas fuses the "
+                        "emit/receive-land/drain chains into single "
+                        "passes against the ROOFLINE.json floors "
                         "(bit-identical, A/B-pinned); xla reproduces "
                         "prior trajectories bit-for-bit; auto = pallas "
                         "only when the TPU capability probe passes, else "

@@ -435,6 +435,7 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
     # wall-clock win (empty slots are rare once delays spread the wave).
     multi = cfg.multi_rumor
     dkern = cfg.deliver_kernel_resolved
+    p2 = cfg.phase2_kernel_resolved if multi else "xla"
     if multi:
         target = int(math.ceil(cfg.coverage_target * cfg.n))
 
@@ -451,11 +452,23 @@ def make_tick_fn(cfg: Config) -> Callable[[SimState, jax.Array], SimState]:
             dst, slots, valid, blk = edges_from_senders(
                 cfg, stp.friends, stp.friend_cnt, senders, dslot,
                 keys["drop"], tick=st.tick)
-            pending = deposit_local(stp.pending, dst, slots, valid,
-                                    kernel=dkern)
-            stp = stp._replace(pending_rumors=deposit_rumors(
-                stp.pending_rumors, dst, slots, valid, newbits,
-                kernel=dkern))
+            if p2 == "pallas":
+                # Phase-2 megakernel: the counting add and the R-row
+                # rumor add land at the shared (slot, dst) cell in ONE
+                # joint pass (integer adds commute -> bit-identical to
+                # the sequential pair below).
+                from gossip_simulator_tpu.ops import pallas_megakernel \
+                    as mk
+                pending, prum = mk.fused_deposit_both(
+                    stp.pending, stp.pending_rumors, dst, slots, valid,
+                    newbits)
+                stp = stp._replace(pending_rumors=prum)
+            else:
+                pending = deposit_local(stp.pending, dst, slots, valid,
+                                        kernel=dkern)
+                stp = stp._replace(pending_rumors=deposit_rumors(
+                    stp.pending_rumors, dst, slots, valid, newbits,
+                    kernel=dkern))
             hit = (stp.rumor_recv >= target) & (stp.rumor_done < 0)
             stp = stp._replace(rumor_done=jnp.where(
                 hit, stp.tick, stp.rumor_done))

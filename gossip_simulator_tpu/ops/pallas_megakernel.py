@@ -1,0 +1,663 @@
+"""Phase-2 megakernel: the emit->route->deliver window as fused passes.
+
+PR 6 (ops/pallas_deliver) fused the sort/rank/scatter DELIVERY chain; a
+phase-2 window still round-trips the mail ring through HBM between the
+three remaining links: the emission builds its edge/partition/duplicate
+masks and reservation prefix as ~10 separate full-array ops, the sharded
+receive side re-decodes and re-filters routed arrivals before a separate
+ring append, and the pushsum drain walks the slot in dynamic-slice chunks.
+ROOFLINE.json prices each link from the SoA column layout (see
+scripts/profile_window.py --roofline); the kernels here collapse each link
+to ONE serial pass so the bytes actually touched approach that floor.
+
+Four fused passes, one per gate point the -phase2-kernel flag threads
+(config.phase2_kernel_resolved -- same policy as PR 6's -deliver-kernel):
+
+* ``fused_emit``       -- event.append_messages' mask/prefix/scatter chain:
+                          edge masks, partition block, duplicate
+                          suppression, per-slot reservation prefix and the
+                          dual-ring payload scatter in-register.  Draws its
+                          targets from the gathered friends rows and lands
+                          locally-owned deliveries directly into the ring.
+* ``fused_recv_land``  -- the sharded receive side: wire-word decode,
+                          receiving-side duplicate filter and the ring
+                          append as one pass over routed arrivals (what
+                          "lands cross-shard traffic" means at S > 1 --
+                          see the bit-identity note below).
+* ``fused_drain_sum``  -- the pushsum whole-slot drain: entry decode and
+                          integer scatter-add over every due lane, no
+                          chunk round-trips.
+* ``fused_deposit_both`` -- the ring engine's multi-rumor deposit pair
+                          (+1 counting add AND the R-row rumor add) at the
+                          shared (slot, dst) cell in one pass.
+
+Why the fused forms are bit-identical to the XLA chain they replace:
+``fused_emit`` keeps a per-slot VIRTUAL counter incremented by every valid
+sender's reservation size -- exactly the weighted exclusive prefix sum the
+XLA path computes with cumsum -- so every sender sees the same start, the
+same overflow verdict and the same trash-lane diversions (non-edges write
+0 at their unique ``dw*cap + lane`` position, overflowed edges write their
+payload there, matching the unique_indices scatter lane for lane).
+``fused_recv_land`` reproduces mailbox.ring_append's monotone per-slot
+position argument plus the pre-append flags gather, which no append
+mutates.  The two ADD passes commute lane-for-lane (integer adds), the
+same property the pushsum S=1 == S=8 pin rests on.
+
+What the megakernel deliberately does NOT fuse: at S > 1 the drain's
+crash draws are keyed by ring POSITION (ckey + entry slot), and an
+entry's position depends on how the all_to_all interleaved every source
+shard's segments -- unknowable shard-locally.  Landing locally-owned
+deliveries around the collective would therefore shift crash draws at
+crashrate > 0; the S=1 path (where the route is the identity) gets the
+direct landing via ``fused_emit``, and S > 1 gets the fused receive side
+instead.  The pipelined exchange path (-exchange-pipeline double) keeps
+its PR-6 kernels: its route/flush split already owns the overlap win.
+
+Gate policy mirrors pallas_deliver verbatim: interpret=True is the CPU CI
+parity surface, ``auto`` resolves to pallas only on a real TPU backend
+after the one-shot probe below passes on-device parity, explicit ``xla``
+never probes, explicit ``pallas`` raises the named reason when
+unavailable.  Block sizes for the drain/receive passes resolve through
+tuning.py (pallas_megakernel.drain_block / recv_block, "never"-persist
+until real TPU evidence lands -- same class as pallas_graph.block_rows).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gossip_simulator_tpu import tuning as _tuning
+from gossip_simulator_tpu.ops.pallas_deliver import (_default_interpret,
+                                                     _interpret_param)
+
+I32 = jnp.int32
+
+# Serial-loop unroll factors for the bounded passes (fori trip count drops
+# by the factor; lanes inside a block still apply in order).  The emit
+# kernel takes NO unroll: its trash-lane uniqueness argument is per-lane
+# and row trip counts are already small.  Defaults are deliberate
+# placeholders pending TPU evidence -- resolve via tuning.value so the
+# block_shapes sweep space can move them without code edits.
+DRAIN_BLOCK = 8
+RECV_BLOCK = 8
+
+
+def _drain_block() -> int:
+    return int(_tuning.value("pallas_megakernel.drain_block", None,
+                             default=DRAIN_BLOCK))
+
+
+def _recv_block() -> int:
+    return int(_tuning.value("pallas_megakernel.recv_block", None,
+                             default=RECV_BLOCK))
+
+
+# ---------------------------------------------------------------------------
+# Fused emission: event.append_messages' mask -> prefix -> scatter chain.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _emit_kernel(k: int, dw: int, cap: int, b: int, tb, has_part: bool,
+                 has_dup: bool, rbit: int, width):
+    """One serial pass over sender rows.  Statics: k friends columns, dw
+    arrival windows, cap per-slot capacity, b batch ticks, tb the SIR
+    trigger base (None = no trigger column), rbit the RECEIVED flag bit,
+    width the packed rumor word count (None = id ring only).  Lanes per
+    row KW = k (+1 with the trigger column)."""
+    kw = k + (1 if tb is not None else 0)
+
+    def kernel(*refs):
+        # Ref layout: aliased inputs (ids[, words], vcnt, adds, sup,
+        # lost, blk), read-only inputs, then the aliased outputs in the
+        # same order (the pallas_call convention -- see _chunk_kernel).
+        na = 6 + (1 if width is not None else 0)
+        nro = (6 + (1 if has_part else 0) + (1 if has_dup else 0)
+               + (2 if tb is not None else 0)
+               + (1 if width is not None else 0))
+        ro = list(refs[na:na + nro])
+        base_ref, sf_ref, drop_ref = ro.pop(0), ro.pop(0), ro.pop(0)
+        sv_ref, ws_ref, off_ref = ro.pop(0), ro.pop(0), ro.pop(0)
+        pm_ref = ro.pop(0) if has_part else None
+        fl_ref = ro.pop(0) if has_dup else None
+        st_ref = ro.pop(0) if tb is not None else None
+        sid_ref = ro.pop(0) if tb is not None else None
+        sw_ref = ro.pop(0) if width is not None else None
+        out = list(refs[na + nro:])
+        ids_ref = out.pop(0)
+        words_ref = out.pop(0) if width is not None else None
+        vcnt_ref, adds_ref, sup_ref, lost_ref, blk_ref = out
+        m = sv_ref.shape[0]
+
+        def body(i, _):
+            v = sv_ref[i] != 0
+            o = off_ref[i]
+            sc = ws_ref[i]
+            evs = []
+            pays = []
+            ec = jnp.zeros((), I32)
+            dcnt = jnp.zeros((), I32)
+            blkn = jnp.zeros((), I32)
+            # Lane pass 1 (static unroll over the tiny friends axis):
+            # edge verdicts, partition block, duplicate filter, kept count.
+            for kk in range(k):
+                f = sf_ref[i, kk]
+                e = v & (drop_ref[i, kk] == 0) & (f >= 0)
+                if has_part:
+                    bl = (pm_ref[i, kk] != 0) & e
+                    blkn = blkn + bl.astype(I32)
+                    e = e & ~bl
+                if has_dup:
+                    df = fl_ref[jnp.maximum(f, 0)]
+                    du = e & ((df.astype(I32) & rbit) > 0)
+                    dcnt = dcnt + du.astype(I32)
+                    e = e & ~du
+                evs.append(e)
+                pays.append(f * b + o)
+                ec = ec + e.astype(I32)
+            if tb is not None:
+                # SIR trigger lane right after the kept edges (NOT gated
+                # on svalid -- mirror the XLA concat exactly; dead rows'
+                # triggers only ever reach a trash lane / lost count).
+                et = st_ref[i] != 0
+                evs.append(et)
+                pays.append(tb + sid_ref[i] * b + o)
+                ec = ec + et.astype(I32)
+            # Reservation: virtual per-slot counter over ALL valid senders
+            # == the XLA weighted exclusive prefix (overflowed senders
+            # still advance it; their writes divert, keeping later
+            # senders' verdicts identical).
+            start = base_ref[sc] + vcnt_ref[sc]
+            okr = v & (start + ec <= cap)
+            vcnt_ref[sc] = vcnt_ref[sc] + jnp.where(v, ec, 0)
+            adds_ref[sc] = adds_ref[sc] + jnp.where(okr, ec, 0)
+            sup_ref[sc] = sup_ref[sc] + dcnt
+            lost_ref[0] = lost_ref[0] + jnp.where(okr, 0, ec)
+            if has_part:
+                blk_ref[0] = blk_ref[0] + blkn
+            # Lane pass 2: running kept-edge rank -> flat cell; every lane
+            # writes (non-edges 0 at their UNIQUE trash lane, overflowed
+            # edges their payload there) -- lane-for-lane the
+            # unique_indices scatter's ivals.
+            col = jnp.zeros((), I32)
+            for kk in range(kw):
+                e = evs[kk]
+                wr = e & okr
+                flat = jnp.where(wr, sc * cap + start + col,
+                                 dw * cap + i * kw + kk)
+                ids_ref[flat] = jnp.where(e, pays[kk], 0)
+                if width is not None:
+                    for c in range(width):
+                        wv = sw_ref[i, c]
+                        words_ref[flat, c] = jnp.where(
+                            e, wv, jnp.zeros_like(wv))
+                col = col + e.astype(I32)
+            return 0
+
+        jax.lax.fori_loop(0, m, body, 0)
+
+    return kernel
+
+
+def fused_emit(mail_ids, mail_cnt, sf, drop, svalid, wslot, off, *,
+               dw: int, cap: int, b: int, tb=None, strig=None,
+               sender_ids=None, pmask=None, flags=None, received_bit=1,
+               swords=None, mail_words=None, interpret=None):
+    """Fused form of event.append_messages from the gathered friends rows
+    down: consumes the XLA-computed per-sender draws (sf gather, drop
+    mask, arrival wslot/off -- RNG stays on the XLA side so streams are
+    untouched) and performs masks, reservation and the dual-ring write in
+    one pass.  `pmask` is the RAW partition_blocked matrix (un-ANDed),
+    `flags` the uint8 node flags for duplicate suppression.  Returns
+    (mail_ids, adds[dw], sup_adds[dw], lost, blocked[, mail_words]);
+    blocked is only meaningful when pmask is given."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    m, k = sf.shape
+    width = None if swords is None else int(swords.shape[1])
+    kern = _emit_kernel(k, dw, cap, b,
+                        None if tb is None else int(tb),
+                        pmask is not None, flags is not None,
+                        int(received_bit), width)
+    z = jnp.zeros((dw,), I32)
+    z1 = jnp.zeros((1,), I32)
+    aliased = [mail_ids] + ([mail_words] if width is not None else []) \
+        + [z, z, z, z1, z1]
+    ro = [mail_cnt[0], sf.astype(I32), drop.astype(I32),
+          svalid.astype(I32), wslot.astype(I32), off.astype(I32)]
+    if pmask is not None:
+        ro.append(pmask.astype(I32))
+    if flags is not None:
+        ro.append(flags)
+    if tb is not None:
+        ro.append(strig.astype(I32))
+        ro.append(sender_ids.astype(I32))
+    if width is not None:
+        ro.append(swords)
+    outs = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in aliased],
+        input_output_aliases={i: i for i in range(len(aliased))},
+        interpret=ip,
+    )(*aliased, *ro)
+    mail_ids = outs[0]
+    j = 1
+    if width is not None:
+        mail_words = outs[1]
+        j = 2
+    # outs[j] is the virtual counter (internal); the observables follow.
+    adds, sup = outs[j + 1], outs[j + 2]
+    lost, blk = outs[j + 3], outs[j + 4]
+    if width is not None:
+        return mail_ids, adds, sup, lost[0], blk[0], mail_words
+    return mail_ids, adds, sup, lost[0], blk[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused receive-side landing: decode + duplicate filter + ring append.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _recv_kernel(dw: int, cap: int, b: int, has_dup: bool, rbit: int,
+                 width, m: int, blk: int):
+    """One pass over M routed wire words: -1-sentinel validity, positional
+    decode, receiving-side duplicate gather and the mailbox ring-append
+    convention (ok-only count increments, single dw*cap trash cell)."""
+
+    def kernel(*refs):
+        # Aliased: ids[, words], cnt, drop[, sup]; read-only: recv
+        # [, flags][, word matrix]; then the aliased outputs.
+        nal = (3 + (1 if has_dup else 0)
+               + (1 if width is not None else 0))
+        nro = (1 + (1 if has_dup else 0)
+               + (1 if width is not None else 0))
+        ro = list(refs[nal:nal + nro])
+        recv_ref = ro.pop(0)
+        fl_ref = ro.pop(0) if has_dup else None
+        wv_ref = ro.pop(0) if width is not None else None
+        out = list(refs[nal + nro:])
+        ids_ref = out.pop(0)
+        words_ref = out.pop(0) if width is not None else None
+        cnt_ref, drop_ref = out.pop(0), out.pop(0)
+        sup_ref = out.pop(0) if has_dup else None
+
+        def lane(i):
+            rw = recv_ref[i]
+            rv = rw >= 0
+            r = jnp.maximum(rw, 0)
+            d = r // (dw * b)
+            w = (r // b) % dw
+            o = r % b
+            if has_dup:
+                df = fl_ref[d]
+                du = rv & ((df.astype(I32) & rbit) > 0)
+                sup_ref[w] = sup_ref[w] + du.astype(I32)
+                rv = rv & ~du
+            pos = cnt_ref[w]
+            ok = rv & (pos < cap)
+            flat = jnp.where(ok, w * cap + pos, dw * cap)
+            ids_ref[flat] = jnp.where(ok, d * b + o, 0)
+            if width is not None:
+                for c in range(width):
+                    wv = wv_ref[i, c]
+                    words_ref[flat, c] = jnp.where(
+                        ok, wv, jnp.zeros_like(wv))
+            cnt_ref[w] = pos + ok.astype(I32)
+            drop_ref[0] = drop_ref[0] + (rv & ~ok).astype(I32)
+
+        nfull = m // blk
+
+        def body(jb, _):
+            for t in range(blk):
+                lane(jb * blk + t)
+            return 0
+
+        jax.lax.fori_loop(0, nfull, body, 0)
+        for i in range(nfull * blk, m):
+            lane(i)
+
+    return kernel
+
+
+def fused_recv_land(mail_ids, mail_cnt, dropped, recv, *, dw: int,
+                    cap: int, b: int, words=None, mail_words=None,
+                    flags=None, received_bit=1, interpret=None):
+    """Fused sharded receive side: for each routed wire word (-1 =
+    empty slot) decode (dst_local, wslot, off), optionally apply the
+    receiving-side duplicate filter against `flags`, and append into the
+    local mail ring -- the decode/filter/rank/scatter chain of
+    event_sharded._route_and_append's post-exchange half as ONE pass.
+    `words` is the (M, W) word matrix (garbage in empty slots is fine:
+    nothing invalid is ever written).  Returns
+    (mail_ids, mail_cnt, dropped, sup_adds[, mail_words]); sup_adds is
+    zeros when `flags` is None."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    m = int(recv.shape[0])
+    width = None if words is None else int(words.shape[1])
+    has_dup = flags is not None
+    kern = _recv_kernel(dw, cap, b, has_dup, int(received_bit), width,
+                        m, max(1, _recv_block()))
+    cf = mail_cnt.reshape(-1)
+    d1 = dropped.reshape(1)
+    aliased = [mail_ids] + ([mail_words] if width is not None else []) \
+        + [cf, d1] + ([jnp.zeros((dw,), I32)] if has_dup else [])
+    ro = [recv.astype(I32)]
+    if has_dup:
+        ro.append(flags)
+    if width is not None:
+        ro.append(words)
+    outs = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in aliased],
+        input_output_aliases={i: i for i in range(len(aliased))},
+        interpret=ip,
+    )(*aliased, *ro)
+    mail_ids = outs[0]
+    j = 1
+    if width is not None:
+        mail_words = outs[1]
+        j = 2
+    cf, d1 = outs[j], outs[j + 1]
+    sup = outs[j + 2] if has_dup else jnp.zeros((dw,), I32)
+    cnt = cf.reshape(mail_cnt.shape)
+    if width is not None:
+        return mail_ids, cnt, d1[0], sup, mail_words
+    return mail_ids, cnt, d1[0], sup
+
+
+# ---------------------------------------------------------------------------
+# Fused pushsum drain: whole-slot decode + integer scatter-add.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _drain_kernel(n: int, cols: int, cap: int, b: int, blk: int):
+    def kernel(_, slot_ref, m_ref, ids_ref, mass_ref, acc_ref):
+        s0 = slot_ref[0] * cap
+        m = m_ref[0]
+
+        def lane(idx):
+            ok = idx < m
+            ent = ids_ref[s0 + idx]
+            row = ent // b
+            # mode="drop" equivalence: masked / out-of-range lanes add
+            # zero at row 0 (integer adds commute, order immaterial).
+            inb = ok & (row >= 0) & (row < n)
+            ix = jnp.where(inb, row, 0)
+            for c in range(cols):
+                v = mass_ref[s0 + idx, c]
+                acc_ref[ix, c] = acc_ref[ix, c] + jnp.where(
+                    inb, v, jnp.zeros_like(v))
+
+        nfull = cap // blk
+
+        def body(jb, _):
+            for t in range(blk):
+                lane(jb * blk + t)
+            return 0
+
+        jax.lax.fori_loop(0, nfull, body, 0)
+        for i in range(nfull * blk, cap):
+            lane(i)
+
+    return kernel
+
+
+def fused_drain_sum(acc, mail_ids, mail_mass, slot, m, *, cap: int,
+                    b: int, interpret=None):
+    """The pushsum drain as one whole-slot pass: every lane of window
+    `slot` decodes its destination row (entry // b) and scatter-adds its
+    mass limbs into `acc` -- replacing the dynamic-slice chunk loop over
+    deposit_sum.  `m` is the live entry count (lanes past it are masked);
+    the full static `cap` is scanned, which subsumes the sharded engine's
+    pmax-agreed chunk count.  Integer adds commute, so the result is
+    bit-identical to any chunking.  Returns the updated acc."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    n, cols = int(acc.shape[0]), int(acc.shape[1])
+    kern = _drain_kernel(n, cols, cap, b, max(1, _drain_block()))
+    (acc,) = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(acc.shape, acc.dtype)],
+        input_output_aliases={0: 0},
+        interpret=ip,
+    )(acc, jnp.reshape(slot, (1,)).astype(I32),
+      jnp.reshape(m, (1,)).astype(I32), mail_ids, mail_mass)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-rumor deposit: the +1 count add and the R-row rumor add.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _deposit_both_kernel(bslots: int, n: int, r: int, k: int):
+    def kernel(_, __, slot_ref, dst_ref, nb_ref, p_ref, pr_ref):
+        m = slot_ref.shape[0]
+
+        def body(i, _):
+            sl = slot_ref[i]
+            d = dst_ref[i]
+            ok = (sl >= 0) & (sl < bslots) & (d >= 0) & (d < n)
+            idx = jnp.where(ok, sl * n + d, 0)
+            p_ref[idx] = p_ref[idx] + ok.astype(p_ref.dtype)
+            for c in range(r):
+                v = nb_ref[i // k, c]
+                pr_ref[idx, c] = pr_ref[idx, c] + jnp.where(
+                    ok, v, jnp.zeros_like(v))
+            return 0
+
+        jax.lax.fori_loop(0, m, body, 0)
+
+    return kernel
+
+
+def fused_deposit_both(pending, pending_rumors, dst, slots, valid,
+                       newbits, interpret=None):
+    """epidemic.deposit_local AND deposit_rumors as one joint pass: each
+    kept edge lands its +1 counting add and its sender's R new-rumor-bit
+    row at the shared (slot, dst) cell.  `dst` carries the caller's edge
+    layout ((n*k,) local ids, row-major by sender); the sender's newbits
+    row is gathered in-register (i // k) instead of materializing the
+    (n*k, R) broadcast.  Integer adds commute -> bit-identical to the
+    sequential pair.  Returns (pending, pending_rumors)."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    bslots, n = int(pending.shape[0]), int(pending.shape[1])
+    r = int(newbits.shape[1])
+    k = int(dst.shape[0]) // int(newbits.shape[0])
+    d = jnp.where(valid, dst, n)
+    kern = _deposit_both_kernel(bslots, n, r, k)
+    pf = pending.reshape(-1)
+    prf = pending_rumors.reshape(bslots * n, r)
+    pf, prf = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(pf.shape, pf.dtype),
+                   jax.ShapeDtypeStruct(prf.shape, prf.dtype)],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=ip,
+    )(pf, prf, slots.astype(I32), d.astype(I32),
+      newbits.astype(prf.dtype))
+    return pf.reshape(pending.shape), prf.reshape(pending_rumors.shape)
+
+
+# ---------------------------------------------------------------------------
+# Capability probes (one-shot, threaded out of ambient traces -- the PR-6
+# pattern: config.phase2_kernel_resolved is read inside jit closures).
+# ---------------------------------------------------------------------------
+
+
+def _probe_case(interpret: bool) -> str:
+    """Tiny concrete parity cases for every fused pass vs its XLA form;
+    '' on bit-identical results, else a named reason.  Runs on a fresh
+    thread: trace contexts are thread-local, so the comparisons stay
+    eager even when the (lru_cached) gate fires mid-trace."""
+    import threading
+
+    out: list = []
+
+    def run():
+        try:
+            out.append(_probe_case_impl(interpret))
+        except Exception as e:  # noqa: BLE001 - reported as the reason
+            out.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    return out[0]
+
+
+def _probe_case_impl(interpret: bool) -> str:
+    from gossip_simulator_tpu.models import epidemic
+    from gossip_simulator_tpu.ops import mailbox as mb
+
+    # --- drain: fused whole-slot scan vs chunked deposit_sum ------------
+    n, cols, cap, b = 5, 3, 8, 4
+    ids = jnp.arange(2 * cap, dtype=I32) * 3 % (n * b)
+    mass = (jnp.arange(2 * cap * cols, dtype=I32).reshape(2 * cap, cols)
+            + 1)
+    acc0 = jnp.ones((n, cols), I32)
+    m = jnp.asarray(5, I32)
+    fa = fused_drain_sum(acc0, ids, mass, jnp.asarray(1, I32), m,
+                         cap=cap, b=b, interpret=interpret)
+    ok = jnp.arange(cap, dtype=I32) < m
+    xa = mb.deposit_sum(acc0, ids[cap:] // b, mass[cap:], ok)
+    if not bool((fa == xa).all()):
+        return "fused drain sum diverged from the XLA reference"
+
+    # --- receive landing vs decode + filter + ring_append ---------------
+    dw, rcap, b2 = 3, 2, 4
+    nl = 4
+    flags = jnp.array([0, 1, 0, 1], jnp.uint8)
+    wire = []
+    for d, w, o, v in ((0, 1, 2, 1), (1, 1, 0, 1), (2, 0, 3, 1),
+                       (0, 0, 0, 0), (3, 1, 1, 1), (2, 1, 2, 1),
+                       (1, 2, 1, 1)):
+        wire.append(d * (dw * b2) + w * b2 + o if v else -1)
+    recv = jnp.array(wire, I32)
+    wv = (jnp.arange(recv.shape[0] * 2, dtype=jnp.uint32)
+          .reshape(-1, 2) + 7)
+    ring0 = jnp.zeros((dw * rcap + 1,), I32)
+    wring0 = jnp.zeros((dw * rcap + 1, 2), jnp.uint32)
+    cnt0 = jnp.zeros((1, dw), I32)
+    fi, fc, fd, fs, fw = fused_recv_land(
+        ring0, cnt0, jnp.zeros((), I32), recv, dw=dw, cap=rcap, b=b2,
+        words=wv, mail_words=wring0, flags=flags, interpret=interpret)
+    rv = recv >= 0
+    r = jnp.maximum(recv, 0)
+    rd, rw_, ro = r // (dw * b2), (r // b2) % dw, r % b2
+    dup = rv & ((flags.at[rd].get() & jnp.uint8(1)) > 0)
+    xs = ((rw_[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+          & dup[:, None]).sum(axis=0, dtype=I32)
+    rv = rv & ~dup
+    wvx = jnp.where(rv[:, None], wv, jnp.uint32(0))
+    (xi, xw), xc, xd = mb.ring_append(
+        (ring0, wring0), cnt0, jnp.zeros((), I32),
+        (rd * b2 + ro, wvx), rw_, rv, dw, rcap)
+    if not (bool((fi == xi).all()) and bool((fw == xw).all())
+            and bool((fc == xc).all()) and int(fd) == int(xd)
+            and bool((fs == xs).all())):
+        return "fused receive landing diverged from the XLA reference"
+
+    # --- joint deposit vs deposit_local + deposit_rumors ----------------
+    bs, nn, rr, kk = 3, 4, 2, 2
+    dst = jnp.array([0, 1, 3, 3, 2, 0, 1, 2], I32)
+    slots = jnp.array([0, 1, 2, 0, 1, 2, 0, 1], I32)
+    valid = jnp.array([1, 1, 0, 1, 1, 1, 0, 1], bool)
+    nb = (jnp.arange(nn * rr, dtype=I32).reshape(nn, rr) % 2)
+    p0 = jnp.zeros((bs, nn), I32)
+    pr0 = jnp.zeros((bs, nn, rr), I32)
+    fp, fpr = fused_deposit_both(p0, pr0, dst, slots, valid, nb,
+                                 interpret=interpret)
+    xp = epidemic.deposit_local(p0, dst, slots, valid)
+    xpr = epidemic.deposit_rumors(pr0, dst, slots, valid, nb)
+    if not (bool((fp == xp).all()) and bool((fpr == xpr).all())):
+        return "fused joint deposit diverged from the XLA reference"
+
+    # --- emission vs an inline replica of the reservation chain ---------
+    # (full-system parity against event.append_messages itself is pinned
+    # by tests/test_megakernel.py; the probe checks the kernel contract
+    # on a case with overflow, duplicates and a dead row.)
+    me, ke, dwe, cape, be = 4, 3, 2, 3, 4
+    sf = jnp.array([[1, 2, -1], [0, 3, 1], [2, -1, -1], [3, 0, 1]], I32)
+    drop = jnp.zeros((me, ke), bool).at[1, 1].set(True)
+    sv = jnp.array([1, 1, 0, 1], bool)
+    ws = jnp.array([0, 1, 0, 0], I32)
+    off = jnp.array([2, 1, 0, 3], I32)
+    fl = jnp.array([1, 0, 0, 1], jnp.uint8)
+    ring0 = jnp.zeros((dwe * cape + me * ke,), I32)
+    cnt0 = jnp.array([[1, 0]], I32)
+    fi2, fad, fsu, flo, _ = fused_emit(
+        ring0, cnt0, sf, drop, sv, ws, off, dw=dwe, cap=cape, b=be,
+        flags=fl, interpret=interpret)
+    edge = sv[:, None] & ~drop & (sf >= 0)
+    dstf = fl.at[jnp.where(sf >= 0, sf, 0)].get()
+    dup = edge & ((dstf & jnp.uint8(1)) > 0)
+    dc = dup.sum(axis=1, dtype=I32)
+    edge = edge & ~dup
+    colsx = jnp.cumsum(edge, axis=1, dtype=I32) - 1
+    ec = edge.sum(axis=1, dtype=I32)
+    pay = sf * be + off[:, None]
+    oh = ((ws[:, None] == jnp.arange(dwe, dtype=I32)[None, :])
+          & sv[:, None]).astype(I32)
+    xsu = (oh * dc[:, None]).sum(axis=0)
+    w = oh * ec[:, None]
+    seg = ((jnp.cumsum(w, axis=0) - w) * oh).sum(axis=1)
+    base = (cnt0[0][None, :] * oh).sum(axis=1)
+    okx = sv & (base + seg + ec <= cape)
+    lanes = jnp.arange(me * ke, dtype=I32).reshape(me, ke)
+    flat = jnp.where(edge & okx[:, None],
+                     ws[:, None] * cape + (base + seg)[:, None] + colsx,
+                     dwe * cape + lanes)
+    xi2 = ring0.at[flat.reshape(-1)].set(
+        jnp.where(edge, pay, 0).reshape(-1), unique_indices=True)
+    xad = (w * okx[:, None]).sum(axis=0)
+    xlo = (edge & ~okx[:, None]).sum(dtype=I32)
+    if not (bool((fi2 == xi2).all()) and bool((fad == xad).all())
+            and bool((fsu == xsu).all()) and int(flo) == int(xlo)):
+        return "fused emission diverged from the XLA reference"
+    return ""
+
+
+@functools.lru_cache(maxsize=1)
+def interpret_unsupported() -> str:
+    """'' when every fused megakernel pass runs (and matches XLA) in
+    interpret mode on this jax build; else the reason (the CPU-CI
+    gate)."""
+    try:
+        return _probe_case(interpret=True)
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        return f"{type(e).__name__}: {e}"
+
+
+@functools.lru_cache(maxsize=1)
+def tpu_unsupported() -> str:
+    """'' when the fused passes lower AND pass on-device parity on a real
+    TPU backend; else the named reason (the auto gate policy)."""
+    if jax.default_backend() != "tpu":
+        return ("no TPU backend "
+                f"(jax.default_backend()={jax.default_backend()!r})")
+    try:
+        return _probe_case(interpret=False)
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        return f"{type(e).__name__}: {e}"
+
+
+def kernel_unavailable_reason() -> str:
+    """'' when `-phase2-kernel pallas` can run on THIS host (natively on
+    TPU, interpret mode elsewhere); else the named reason."""
+    if jax.default_backend() == "tpu":
+        return tpu_unsupported()
+    return interpret_unsupported()

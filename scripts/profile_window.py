@@ -68,6 +68,198 @@ def parse_trace(trace_dir: str,
     return [(nm, ms, cnt[nm]) for nm, ms in agg.most_common(top)], loop_total
 
 
+# ---------------------------------------------------------------------------
+# Roofline (--roofline): bytes-touched-per-delivered-message from the SoA
+# column layout, combined with the measured CPU floors already committed in
+# PROFILE_OVERLAY.json / PROFILE_EXCHANGE.json, written to ROOFLINE.json.
+# The table is the commitment the phase-2 megakernel is judged against:
+# each term lists the minimum memory traffic its fused pass can touch, so a
+# measured ns/message divides into a stated factor-off-roofline.
+# ---------------------------------------------------------------------------
+
+# HBM bandwidth the ns/message floors are quoted at.  TPU v4 HBM2 is
+# 1228 GB/s per chip (public spec); the CPU column uses the measured
+# dense-delivery floor instead of a paper number.
+TPU_V4_HBM_GBPS = 1228.0
+
+
+def _roofline_terms(fanout: int, rumors: int, pushsum_dim: int) -> dict:
+    """Analytic bytes/message per pipeline term, from the SoA layout:
+    uint8 node flags, int32 counters/ids, uint32 rumor words packed 32/word,
+    int32 pushsum limbs (LIMBS 16-bit limbs per scalar, weight block last).
+    Amortized per-row reads divide by fanout (one row emits k messages)."""
+    from gossip_simulator_tpu.models import pushsum as ps
+
+    k = fanout
+    w = max(1, -(-rumors // 32))          # packed uint32 words/node
+    c = (pushsum_dim + 1) * ps.LIMBS      # int32 mass columns/node
+    terms = {
+        "emit": {
+            "bytes_per_message": 4 + 1 + 4 + 4 * w + 8.0 / k,
+            "derivation": "friends id read (int32 4) + dest flag read for "
+                          "the duplicate filter (uint8 1) + mail-ring id "
+                          "write (int32 4) + rumor-word row write "
+                          f"(uint32 4*W={4 * w}) + per-sender wslot/off "
+                          f"draws amortized over k={k} edges (8/k)",
+        },
+        "route": {
+            "bytes_per_message": 4 * (4 + 4 * w),
+            "derivation": "sharded only: mail read + wire encode + "
+                          "all_to_all landing read + local ring write, "
+                          f"each (4 + 4*W={4 + 4 * w}) for the id and "
+                          "its word row; S=1 runs this term at 0",
+        },
+        "deliver": {
+            "bytes_per_message": 4 + 4 * w + 1 + 1 + 4,
+            "derivation": "mail id read (4) + word row read "
+                          f"(4*W={4 * w}) + dest flag read+write "
+                          "(uint8 1+1) + received counter update "
+                          "(int32 4)",
+        },
+        "combine": {
+            "bytes_per_message": 8 * w,
+            "derivation": "first-touch OR into the packed rumor words: "
+                          f"read + write 4*W={4 * w} each "
+                          f"(pushsum twin: read+add+write {4 * c} B over "
+                          f"C={c} int32 limb columns = {8 * c} B)",
+            "pushsum_bytes_per_message": 8 * c,
+        },
+    }
+    total = sum(t["bytes_per_message"] for t in terms.values())
+    return terms, total, w, c
+
+
+def _measure_interpret_megakernel() -> dict:
+    """CPU-scale measured rows for the fused passes in interpret mode.
+    Interpret mode is the correctness surface, not a fast path -- these
+    rows exist so ROOFLINE.json states the measured parity cost next to
+    the analytic floor instead of implying interpret speed matters."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_simulator_tpu.ops import pallas_megakernel as mk
+
+    rng = np.random.default_rng(0)
+    m, k, dw, cap, b = 2048, 6, 2, 8192, 8
+    n = 4096
+    sf = jnp.asarray(rng.integers(0, n, (m, k)), jnp.int32)
+    drop = jnp.asarray(rng.random((m, k)) < 0.1)
+    sv = jnp.asarray(rng.random(m) < 0.9)
+    ws = jnp.asarray(rng.integers(0, dw, m), jnp.int32)
+    off = jnp.asarray(rng.integers(0, b, m), jnp.int32)
+    ring = jnp.zeros((dw * cap + m * k,), jnp.int32)
+    cnt = jnp.zeros((1, dw), jnp.int32)
+    t0 = time.perf_counter()
+    out = mk.fused_emit(ring, cnt, sf, drop, sv, ws, off, dw=dw, cap=cap,
+                        b=b, interpret=True)
+    jax.block_until_ready(out[0])
+    emit_s = time.perf_counter() - t0
+    lanes = m * k
+
+    ids = jnp.asarray(rng.integers(0, n * b, dw * cap), jnp.int32)
+    mass = jnp.asarray(rng.integers(-9, 9, (dw * cap, 8)), jnp.int32)
+    acc = jnp.zeros((n, 8), jnp.int32)
+    t0 = time.perf_counter()
+    acc = mk.fused_drain_sum(acc, ids, mass, jnp.asarray(0, jnp.int32),
+                             jnp.asarray(cap, jnp.int32), cap=cap, b=b,
+                             interpret=True)
+    jax.block_until_ready(acc)
+    drain_s = time.perf_counter() - t0
+    return {
+        "mode": "interpret (single trace+run, CPU correctness surface)",
+        "emit_lanes": lanes,
+        "emit_s": emit_s,
+        "emit_ns_per_lane": emit_s / lanes * 1e9,
+        "drain_lanes": cap,
+        "drain_s": drain_s,
+        "drain_ns_per_lane": drain_s / cap * 1e9,
+    }
+
+
+def write_roofline(out_path: str, fanout: int, rumors: int,
+                   pushsum_dim: int, date: str) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    terms, total, w, c = _roofline_terms(fanout, rumors, pushsum_dim)
+    for t in terms.values():
+        t["ns_per_message_at_tpu_v4_hbm"] = (
+            t["bytes_per_message"] / TPU_V4_HBM_GBPS)
+    evidence = []
+    po = os.path.join(repo, "PROFILE_OVERLAY.json")
+    if os.path.exists(po):
+        d = json.load(open(po))
+        fl = d["rows"]["chunk_floor"]
+        vals = [v["dense_ns_per_lane"] for v in fl.values()]
+        evidence.append({
+            "source": "PROFILE_OVERLAY.json",
+            "row": "chunk_floor.*.dense_ns_per_lane",
+            "ns_per_lane": [round(v, 1) for v in vals],
+            "note": "measured CPU dense delivery floor (XLA, per mail "
+                    "lane) -- the deliver term's CPU reality check",
+        })
+    pe = os.path.join(repo, "PROFILE_EXCHANGE.json")
+    if os.path.exists(pe):
+        d = json.load(open(pe))
+        rr = d["rows"]["route"]["rank_zero_loss"]
+        evidence.append({
+            "source": "PROFILE_EXCHANGE.json",
+            "row": "route.rank_zero_loss.ns_per_lane",
+            "ns_per_lane": round(rr["ns_per_lane"], 1),
+            "note": "measured CPU rank route (XLA, per wire lane) -- "
+                    "the route term's CPU reality check",
+        })
+    meas = _measure_interpret_megakernel()
+    evidence.append({
+        "source": "measured this session",
+        "row": "pallas_megakernel interpret",
+        "emit_ns_per_lane": round(meas["emit_ns_per_lane"], 1),
+        "drain_ns_per_lane": round(meas["drain_ns_per_lane"], 1),
+        "note": meas["mode"],
+    })
+    doc = {
+        "session": "r18",
+        "date": date,
+        "device": "cpu (TPU rows queued -- see tpu_status)",
+        "hbm_bw_GBps": {"tpu_v4": TPU_V4_HBM_GBPS,
+                        "source": "public chip spec; CPU floors are "
+                                  "measured, not quoted"},
+        "layout": {
+            "node_flags": "uint8[n]",
+            "counters": "int32 (received counts, ring counts, mass "
+                        "residue)",
+            "rumor_words": f"uint32[n, W], W=ceil(R/32)={w} at R={rumors}",
+            "pushsum_mass": f"int32[n, (dim+1)*LIMBS]={c} cols at "
+                            f"dim={pushsum_dim}",
+            "mail_ring": "int32[dw*cap] ids (+ uint32[dw*cap, W] words)",
+        },
+        "shape": {"fanout": fanout, "rumors": rumors, "words": w,
+                  "pushsum_dim": pushsum_dim},
+        "terms": terms,
+        "total_bytes_per_message": round(total, 2),
+        "total_ns_per_message_at_tpu_v4_hbm": round(
+            total / TPU_V4_HBM_GBPS, 4),
+        "evidence": evidence,
+        "tpu_status": {
+            "status": "queued",
+            "queued_since": "r18",
+            "date": date,
+            "note": "TPU pool unreachable this session (same standing "
+                    "failure recorded in BENCH.md since r06); the "
+                    "megakernel_50m_twins bench row will report measured "
+                    "ns/message against total_ns_per_message_at_tpu_v4_"
+                    "hbm when hardware is reachable",
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    ps_msg = doc["total_ns_per_message_at_tpu_v4_hbm"] * 1e3
+    print(f"wrote {out_path}: total {doc['total_bytes_per_message']} "
+          f"B/message -> {ps_msg:.3f} ps/message at TPU v4 HBM")
+    for nm, t in terms.items():
+        print(f"  {nm:8s} {t['bytes_per_message']:7.2f} B/msg")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000_000)
@@ -82,7 +274,23 @@ def main() -> int:
                          "instead (use --overlay-mode to pick the engine)")
     ap.add_argument("--overlay-mode", choices=("rounds", "ticks"),
                     default="rounds")
+    ap.add_argument("--roofline", action="store_true",
+                    help="derive the per-term bytes/message roofline from "
+                         "the SoA layout plus the committed CPU floors and "
+                         "write it to --roofline-out (no profiling run)")
+    ap.add_argument("--roofline-out",
+                    default=os.path.join(
+                        os.path.dirname(os.path.dirname(
+                            os.path.abspath(__file__))), "ROOFLINE.json"))
+    ap.add_argument("--rumors", type=int, default=16,
+                    help="roofline R (words = ceil(R/32))")
+    ap.add_argument("--pushsum-dim", type=int, default=1)
+    ap.add_argument("--date", default="2026-08-07",
+                    help="stamp for the roofline / queued TPU rows")
     args = ap.parse_args()
+    if args.roofline:
+        return write_roofline(args.roofline_out, args.fanout, args.rumors,
+                              args.pushsum_dim, args.date)
     on_tpu = jax.default_backend() == "tpu"
     if args.phase == "overlay":
         cfg = Config(n=args.n, graph="overlay",

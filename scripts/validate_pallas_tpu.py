@@ -24,6 +24,13 @@ Checks (all on freshly generated tables):
   PRNG-free, so they also run in interpret mode on CPU hosts
   (--interpret), where the dated verdict is MERGED into the existing
   artifact without disturbing recorded TPU results.
+* megakernel (ISSUE 18, run_megakernel_checks): the -phase2-kernel fused
+  passes (ops/pallas_megakernel) bit-identical to their XLA chains --
+  the emission reservation chain (partition/dup/trigger corners ride on
+  the one-shot probe), the sharded receive landing, the pushsum drain
+  (including chunk-split commutation), and the joint multi-rumor
+  deposit.  PRNG-free like the deliver checks; --interpret merges
+  megakernel_interpret, a TPU pass merges megakernel_tpu.
 
 Run: python scripts/validate_pallas_tpu.py [--out PALLAS_VALIDATION.json]
      python scripts/validate_pallas_tpu.py --interpret   # CPU deliver-only
@@ -265,6 +272,103 @@ def run_deliver_checks() -> dict:
     }
 
 
+def run_megakernel_checks() -> dict:
+    """Bit-identity of the phase-2 fused passes against the XLA chains
+    they replace (ops/pallas_megakernel vs ops/mailbox + models/epidemic
+    + the append_messages reservation chain).  PRNG-free: RNG draws stay
+    on the XLA side by design, so the same assertions hold natively on
+    TPU and in interpret mode on CPU."""
+    import jax.numpy as jnp
+
+    from gossip_simulator_tpu.models import epidemic
+    from gossip_simulator_tpu.ops import mailbox as mb
+    from gossip_simulator_tpu.ops import pallas_megakernel as mk
+
+    mode = "tpu" if jax.default_backend() == "tpu" else "interpret"
+    why = mk.kernel_unavailable_reason()
+    if why:
+        return {"mode": mode, "skipped": why}
+    I32 = jnp.int32
+    checks = []
+
+    def add(name, ok, **detail):
+        checks.append({"name": name, "ok": bool(ok), **detail})
+
+    # The one-shot probe already asserts all four passes on corner cases
+    # (overflow, duplicates, dead rows); record its verdict as a check.
+    probe = (mk.interpret_unsupported() if mode == "interpret"
+             else mk.tpu_unsupported())
+    add("probe_four_pass_parity", probe == "", reason=probe)
+
+    rng = np.random.default_rng(18)
+    # drain: random masses, live-prefix mask, chunk-split commutation.
+    n, cols, cap, b = 7, 8, 24, 4
+    ids = jnp.asarray(rng.integers(0, n * b, 2 * cap), I32)
+    mass = jnp.asarray(rng.integers(-9, 9, (2 * cap, cols)), I32)
+    acc0 = jnp.asarray(rng.integers(0, 5, (n, cols)), I32)
+    m = jnp.asarray(17, I32)
+    fa = mk.fused_drain_sum(acc0, ids, mass, jnp.asarray(1, I32), m,
+                            cap=cap, b=b)
+    ok = jnp.arange(cap, dtype=I32) < m
+    xa = mb.deposit_sum(acc0, ids[cap:] // b, mass[cap:], ok)
+    xa2 = mb.deposit_sum(acc0, ids[cap:cap + 9] // b, mass[cap:cap + 9],
+                         ok[:9])
+    xa2 = mb.deposit_sum(xa2, ids[cap + 9:] // b, mass[cap + 9:], ok[9:])
+    add("drain_sum_parity", bool((fa == xa).all()))
+    add("drain_sum_chunk_split_commutes", bool((fa == xa2).all()))
+
+    # receive landing: random wire with empty slots + duplicate filter.
+    dw, rcap, b2, nl, mw = 3, 5, 4, 6, 64
+    wire = rng.integers(0, nl * dw * b2, mw)
+    wire = np.where(rng.random(mw) < 0.75, wire, -1)
+    recv = jnp.asarray(wire, I32)
+    flags = jnp.asarray(rng.integers(0, 2, nl), jnp.uint8)
+    wv = jnp.asarray(rng.integers(1, 99, (mw, 2)), np.uint32)
+    ring0 = jnp.zeros((dw * rcap + 1,), I32)
+    wring0 = jnp.zeros((dw * rcap + 1, 2), jnp.uint32)
+    cnt0 = jnp.asarray(rng.integers(0, 2, (1, dw)), I32)
+    fi, fc, fd, fs, fw = mk.fused_recv_land(
+        ring0, cnt0, jnp.zeros((), I32), recv, dw=dw, cap=rcap, b=b2,
+        words=wv, mail_words=wring0, flags=flags)
+    rv = recv >= 0
+    r = jnp.maximum(recv, 0)
+    rd, rw_, ro = r // (dw * b2), (r // b2) % dw, r % b2
+    dup = rv & ((flags.at[rd].get() & jnp.uint8(1)) > 0)
+    xs = ((rw_[:, None] == jnp.arange(dw, dtype=I32)[None, :])
+          & dup[:, None]).sum(axis=0, dtype=I32)
+    rv = rv & ~dup
+    wvx = jnp.where(rv[:, None], wv, jnp.uint32(0))
+    (xi, xw), xc, xd = mb.ring_append(
+        (ring0, wring0), cnt0, jnp.zeros((), I32),
+        (rd * b2 + ro, wvx), rw_, rv, dw, rcap)
+    add("recv_land_parity",
+        bool((fi == xi).all()) and bool((fw == xw).all())
+        and bool((fc == xc).all()) and int(fd) == int(xd)
+        and bool((fs == xs).all()))
+
+    # joint deposit vs the sequential pair.
+    bs, nn, rr, kk = 3, 9, 4, 3
+    me = nn * kk
+    dst = jnp.asarray(rng.integers(0, nn, me), I32)
+    slots = jnp.asarray(rng.integers(0, bs, me), I32)
+    valid = jnp.asarray(rng.random(me) < 0.7)
+    nb = jnp.asarray(rng.random((nn, rr)) < 0.5)
+    p0 = jnp.asarray(rng.integers(0, 3, (bs, nn)), I32)
+    pr0 = jnp.asarray(rng.integers(0, 3, (bs, nn, rr)), I32)
+    fp, fpr = mk.fused_deposit_both(p0, pr0, dst, slots, valid, nb)
+    xp = epidemic.deposit_local(p0, dst, slots, valid)
+    xpr = epidemic.deposit_rumors(pr0, dst, slots, valid, nb)
+    add("deposit_both_parity",
+        bool((fp == xp).all()) and bool((fpr == xpr).all()))
+
+    return {
+        "mode": mode,
+        "device": jax.devices()[0].device_kind,
+        "checks": checks,
+        "all_pass": all(c["ok"] for c in checks),
+    }
+
+
 def _merge_out(path: str, updates: dict) -> dict:
     """Merge `updates` into the JSON artifact at `path` (preserving any
     recorded sections -- e.g. the CPU --interpret verdict must not erase
@@ -294,9 +398,13 @@ def main() -> int:
     args = ap.parse_args()
     if args.interpret:
         result = run_deliver_checks()
-        _merge_out(args.out, {"deliver_interpret": result})
-        print(json.dumps(result))
-        return 0 if result.get("all_pass") else 1
+        mega = run_megakernel_checks()
+        _merge_out(args.out, {"deliver_interpret": result,
+                              "megakernel_interpret": mega})
+        print(json.dumps({"deliver_interpret": result,
+                          "megakernel_interpret": mega}))
+        return 0 if (result.get("all_pass")
+                     and mega.get("all_pass")) else 1
     if jax.default_backend() != "tpu":
         print(json.dumps({"skipped": "no TPU present; interpret-mode PRNG "
                                      "validates nothing (use --interpret "
@@ -304,9 +412,13 @@ def main() -> int:
         return 3
     result = run_checks()
     deliver = run_deliver_checks()
-    _merge_out(args.out, {**result, "deliver_tpu": deliver})
-    print(json.dumps({**result, "deliver_tpu": deliver}))
-    return 0 if (result["all_pass"] and deliver.get("all_pass")) else 1
+    mega = run_megakernel_checks()
+    _merge_out(args.out, {**result, "deliver_tpu": deliver,
+                          "megakernel_tpu": mega})
+    print(json.dumps({**result, "deliver_tpu": deliver,
+                      "megakernel_tpu": mega}))
+    return 0 if (result["all_pass"] and deliver.get("all_pass")
+                 and mega.get("all_pass")) else 1
 
 
 if __name__ == "__main__":
