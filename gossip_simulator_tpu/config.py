@@ -220,6 +220,20 @@ class Config:
     # -deliver-kernel: where the megakernel engages it subsumes that
     # gate's fused ops; everywhere else -deliver-kernel still applies.
     phase2_kernel: str = "auto"
+    # Phase-1 overlay megakernel for the request->negotiate->reply chain
+    # (against ROOFLINE.json's phase-1 terms): "pallas" runs the fused
+    # single-pass kernels (ops/pallas_overlay_kernel -- slot negotiation
+    # with its decision masks/draw blends/reply emission in-register,
+    # bootstrap request append with write-time dead-skip counts, hosted
+    # ladder occupancy; natively on TPU, interpret mode elsewhere;
+    # bit-identical, A/B-pinned by trajectory fingerprints); "xla" is the
+    # recorded one-hot op chain and reproduces every prior trajectory
+    # bit-for-bit; "auto" picks pallas only when the one-shot TPU
+    # capability probe passes on-device parity, else xla with a named
+    # reason (phase1_kernel_fallback_reason).  Orthogonal to
+    # -deliver-kernel: the delivery chain keeps its own gate; this one
+    # owns the negotiation passes around it.
+    phase1_kernel: str = "auto"
     # Exchange pipelining for the sharded backend (ROADMAP item 1):
     # "double" software-pipelines the per-chunk all_to_all at chunk
     # granularity -- the ring_append drain of batch j is deferred one
@@ -629,6 +643,36 @@ class Config:
         return pallas_megakernel.tpu_unsupported()
 
     @property
+    def phase1_kernel_resolved(self) -> str:
+        """"xla" or "pallas" -- the phase-1 overlay twin of
+        phase2_kernel_resolved (same lazy policy: explicit "pallas"
+        raises the probe's named reason when this host cannot run the
+        fused passes, "auto" enables pallas only on TPU hosts that pass
+        the on-device parity probe; CPU interpret mode is a CI
+        correctness surface, not a fast path)."""
+        if self.phase1_kernel == "xla":
+            return "xla"
+        from gossip_simulator_tpu.ops import pallas_overlay_kernel
+        if self.phase1_kernel == "pallas":
+            why = pallas_overlay_kernel.kernel_unavailable_reason()
+            if why:
+                raise ValueError(
+                    f"-phase1-kernel pallas is unavailable on this host: "
+                    f"{why} (use -phase1-kernel xla or auto)")
+            return "pallas"
+        return "xla" if pallas_overlay_kernel.tpu_unsupported() else "pallas"
+
+    @property
+    def phase1_kernel_fallback_reason(self) -> str:
+        """Non-empty iff `-phase1-kernel auto` resolved to xla: the
+        probe's named reason, surfaced by the driver so the fallback is
+        never silent."""
+        if self.phase1_kernel != "auto":
+            return ""
+        from gossip_simulator_tpu.ops import pallas_overlay_kernel
+        return pallas_overlay_kernel.tpu_unsupported()
+
+    @property
     def exchange_pipeline_resolved(self) -> str:
         """"off" or "double" -- resolved LAZILY (first model-build time,
         after jaxsetup.setup(); validate() must not import jax).
@@ -692,9 +736,14 @@ class Config:
                 gates["phase2_kernel"] = self.phase2_kernel_resolved
             except ValueError:
                 gates["phase2_kernel"] = "unavailable"
+            try:
+                gates["phase1_kernel"] = self.phase1_kernel_resolved
+            except ValueError:
+                gates["phase1_kernel"] = "unavailable"
         else:
             gates["deliver_kernel"] = None
             gates["phase2_kernel"] = None
+            gates["phase1_kernel"] = None
         # Exchange pipelining only exists on the sharded backend's
         # routed path; everywhere else there is no exchange to overlap.
         gates["exchange_pipeline"] = (
@@ -909,6 +958,10 @@ class Config:
             raise ValueError(
                 f"phase2_kernel must be auto|xla|pallas, "
                 f"got {self.phase2_kernel!r}")
+        if self.phase1_kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"phase1_kernel must be auto|xla|pallas, "
+                f"got {self.phase1_kernel!r}")
         if self.exchange_pipeline not in ("auto", "off", "double"):
             raise ValueError(
                 f"exchange_pipeline must be auto|off|double, "
@@ -1317,6 +1370,17 @@ def _build_parser() -> argparse.ArgumentParser:
                         "prior trajectories bit-for-bit; auto = pallas "
                         "only when the TPU capability probe passes, else "
                         "xla with a named reason")
+    p.add_argument("-phase1-kernel", "--phase1-kernel",
+                   dest="phase1_kernel", choices=("auto", "xla", "pallas"),
+                   default=d.phase1_kernel,
+                   help="phase-1 overlay megakernel: pallas fuses the "
+                        "slot-negotiate/bootstrap-request/hosted-occupancy "
+                        "chains into single passes against the "
+                        "ROOFLINE.json phase-1 floors (bit-identical, "
+                        "A/B-pinned); xla reproduces prior trajectories "
+                        "bit-for-bit; auto = pallas only when the TPU "
+                        "capability probe passes, else xla with a named "
+                        "reason")
     p.add_argument("-exchange-pipeline", "--exchange-pipeline",
                    dest="exchange_pipeline",
                    choices=("auto", "off", "double"),

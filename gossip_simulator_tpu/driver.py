@@ -117,6 +117,14 @@ def _run(cfg: Config, printer: ProgressPrinter,
             p2r = "unavailable"
         printer.note(f"phase2-kernel: {p2r} "
                      f"(requested {cfg.phase2_kernel})")
+    if cfg.backend in ("jax", "sharded") and cfg.phase1_kernel != "auto":
+        # Same explicit-request gate as phase2-kernel above.
+        try:
+            p1r = cfg.phase1_kernel_resolved
+        except ValueError:
+            p1r = "unavailable"
+        printer.note(f"phase1-kernel: {p1r} "
+                     f"(requested {cfg.phase1_kernel})")
     t_init = time.perf_counter()
     with _trace.span("init", cat="phase"):
         stepper.init()

@@ -1,0 +1,463 @@
+"""Phase-1 overlay megakernel: the request->negotiate->reply chain fused.
+
+PR 3 cut the overlay's round count and PR 6/18 fused the *delivery* chunk
+step, but the slot negotiation itself still runs as ~10 separate XLA
+passes per mailbox slot: the makeup side builds its under-fanin mask,
+one-hot append, eviction draw gather and reply blend as distinct
+full-(n, k) ops, the breakup side adds the first-match scan and the
+swap-with-last pair, and the bootstrap block pays another four n-wide
+passes every round.  Each pass round-trips `friends`/`friend_cnt`
+through HBM; ROOFLINE.json's phase-1 terms price what ONE traversal
+would cost (scripts/profile_window.py --roofline).  The kernels here
+collapse each link so a slot column touches the state once.
+
+Three fused passes, one per gate point the -phase1-kernel flag threads
+(config.phase1_kernel_resolved -- same policy as PR 6/18's gates):
+
+* ``fused_negotiate``     -- process_makeup_slot / process_breakup_slot
+                             plus the accept-under-fanin / random-evict /
+                             replace decisions and the reply emission
+                             in-register per slot column (kind="makeup" /
+                             "breakup").
+* ``fused_request_round`` -- the needNewFriend bootstrap append with its
+                             write-time dead-skip count (PR 3's counted
+                             emissions) in the same pass; composes with
+                             -overlay-static-boot, which skips the block
+                             entirely.
+* ``fused_hosted_chunk``  -- per-rung occupancy for the adaptive
+                             hosted_chunk_widths ladder: ALL emission
+                             rows popcounted in one pass / one transfer
+                             instead of a host round-trip per row
+                             (ops.mailbox.make_hosted_column_delivery's
+                             `occupancy` hook).
+
+Why the fused forms are bit-identical to the XLA chain they replace: RNG
+stays on the XLA side, so the draw streams are untouched -- the breakup
+replacement draw (randint_excluding) depends only on (key, shape, src,
+ids) and is computed before the kernel exactly as inside
+process_breakup_slot; the makeup eviction position is drawn with the
+PRE-append counts, which equals the XLA path's post-append draw on every
+row where it is observable (append and evict are disjoint: a row either
+accepts under fanin or evicts at/above it, and non-evicting rows' draws
+never escape the where(ev, ...) blend).  Inside the kernel every decision
+is the same one-hot elementwise form overlay._col_get/_col_set lower to
+(first-match via a masked iota minimum, not argmax -- same index), the
+inert-row replacement write stays an identity write like the XLA
+unmasked _col_set, and the emission counts are integer mask sums, which
+commute across the serial row blocks.
+
+Layout note: the kernels keep the engines' natural node-major (n, k)
+state -- the row axis is what the serial block loop walks, so the k<=16
+minor axis rides along per block instead of forcing the transposed
+layout pallas_graph needs for its (rows-on-lanes) PRNG streams.
+
+Gate policy mirrors pallas_megakernel verbatim: interpret=True is the
+CPU CI parity surface, ``auto`` resolves to pallas only on a real TPU
+backend after the one-shot probe below passes on-device parity, explicit
+``xla`` never probes, explicit ``pallas`` raises the named reason when
+unavailable.  Block sizes resolve through tuning.py
+(pallas_overlay.slot_block / chunk_block, "never"-persist until real TPU
+evidence lands -- same class as pallas_megakernel.drain_block).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gossip_simulator_tpu import tuning as _tuning
+from gossip_simulator_tpu.ops.pallas_deliver import (_default_interpret,
+                                                     _interpret_param)
+
+I32 = jnp.int32
+
+# Rows per serial block of the negotiate/request passes and columns per
+# serial block of the occupancy pass.  Defaults are deliberate
+# placeholders pending TPU evidence -- resolve via tuning.value so the
+# block_shapes sweep space can move them without code edits.
+SLOT_BLOCK = 512
+CHUNK_BLOCK = 1024
+
+
+def _slot_block() -> int:
+    return int(_tuning.value("pallas_overlay.slot_block", None,
+                             default=SLOT_BLOCK))
+
+
+def _chunk_block() -> int:
+    return int(_tuning.value("pallas_overlay.chunk_block", None,
+                             default=CHUNK_BLOCK))
+
+
+# ---------------------------------------------------------------------------
+# Fused negotiation: one mailbox slot's makeup or breakup decisions.
+# ---------------------------------------------------------------------------
+
+
+def _row_blocks(n: int, blk: int):
+    """Serial row-block schedule over n rows: full blocks of width
+    blk_eff, then (when n is ragged) ONE overlapping block at n - blk_eff
+    whose already-processed rows are masked inert.  The overlap trick
+    keeps every device op at the static block width -- no unrolled
+    scalar tail -- and is safe because every state write below is masked
+    by the same validity row mask (masked rows write back their current,
+    already-updated values)."""
+    blk_eff = min(blk, n)
+    nfull = n // blk_eff
+    tail_start = n - blk_eff  # first masked row = nfull * blk_eff
+    return blk_eff, nfull, (n % blk_eff != 0), tail_start
+
+
+@functools.lru_cache(maxsize=None)
+def _negotiate_kernel(kind: str, n: int, k: int, limit: int, blk: int):
+    """One serial pass over row blocks.  Statics: kind ("makeup" /
+    "breakup"), n rows, k friends columns, limit (= fanin for makeup,
+    fanout for breakup), blk rows per block.  Ref layout: aliased inputs
+    (friends, cnt), read-only inputs (src, has, draw), aliased outputs
+    (friends, cnt -- read for the in-place update), fresh outputs
+    (reply)."""
+    blk_eff, nfull, ragged, tail_start = _row_blocks(n, blk)
+
+    def block(start, first_valid, fr_ref, cnt_ref, src_ref, has_ref,
+              draw_ref, reply_ref):
+        rows = start + jax.lax.broadcasted_iota(I32, (blk_eff,), 0)
+        valid = rows >= first_valid
+        fr = fr_ref[pl.ds(start, blk_eff), :]
+        cnt = cnt_ref[pl.ds(start, blk_eff)]
+        src = src_ref[pl.ds(start, blk_eff)]
+        has = (has_ref[pl.ds(start, blk_eff)] > 0) & valid
+        draw = draw_ref[pl.ds(start, blk_eff)]
+        iok = jax.lax.broadcasted_iota(I32, (blk_eff, k), 1)
+        if kind == "makeup":
+            # simulator.go:66-75: accept under fanin, else evict the
+            # pre-drawn uniform victim and take its slot.
+            under = cnt < limit
+            app = has & under
+            oh_app = iok == jnp.minimum(cnt, k - 1)[:, None]
+            fr = jnp.where(oh_app & app[:, None], src[:, None], fr)
+            cnt = cnt + app.astype(I32)
+            ev = has & ~under
+            oh_v = iok == draw[:, None]
+            victim = jnp.where(oh_v, fr, 0).sum(axis=1, dtype=I32)
+            fr = jnp.where(oh_v & ev[:, None], src[:, None], fr)
+            reply = jnp.where(ev, victim, -1)
+        else:
+            # simulator.go:76-94: first-match scan; over fanout ->
+            # swap-with-last removal, else replace in place with the
+            # pre-drawn fresh peer (the reply's makeup target).
+            in_range = iok < cnt[:, None]
+            match = (fr == src[:, None]) & in_range & has[:, None]
+            found = match.astype(I32).max(axis=1) > 0
+            first = jnp.min(jnp.where(match, iok, k), axis=1)
+            pos = jnp.where(found, first, 0)  # == argmax(match) per row
+            over = cnt > limit
+            rm = has & found & over
+            rp = has & found & ~over
+            lastpos = jnp.maximum(cnt - 1, 0)
+            oh_last = iok == lastpos[:, None]
+            lastval = jnp.where(oh_last, fr, 0).sum(axis=1, dtype=I32)
+            oh_pos = iok == pos[:, None]
+            posat = jnp.where(oh_pos, fr, 0).sum(axis=1, dtype=I32)
+            posval = jnp.where(rm, lastval, jnp.where(rp, draw, posat))
+            # The XLA form's UNMASKED in-place write (identity on inert
+            # rows); `valid` only shields the ragged overlap rows.
+            fr = jnp.where(oh_pos & valid[:, None], posval[:, None], fr)
+            fr = jnp.where(oh_last & rm[:, None], -1, fr)
+            cnt = cnt - rm.astype(I32)
+            reply = jnp.where(rp, draw, -1)
+        fr_ref[pl.ds(start, blk_eff), :] = fr
+        cnt_ref[pl.ds(start, blk_eff)] = cnt
+        old = reply_ref[pl.ds(start, blk_eff)]
+        reply_ref[pl.ds(start, blk_eff)] = jnp.where(valid, reply, old)
+
+    def kernel(_, __, src_ref, has_ref, draw_ref, fr_ref, cnt_ref,
+               reply_ref):
+        args = (fr_ref, cnt_ref, src_ref, has_ref, draw_ref, reply_ref)
+        jax.lax.fori_loop(
+            0, nfull,
+            lambda i, _: (block(i * blk_eff, jnp.int32(0), *args), 0)[1],
+            0)
+        if ragged:
+            block(jnp.int32(tail_start), jnp.int32(nfull * blk_eff),
+                  *args)
+
+    return kernel
+
+
+def fused_negotiate(friends, cnt, src, has, draw, *, kind: str,
+                    limit: int, interpret=None):
+    """One mailbox slot of membership decisions for ALL nodes as a single
+    pass over the (n, k) state: the decision masks, one-hot column
+    read/write pair and the reply emission that overlay.process_*_slot
+    runs as separate full-array ops.  `draw` carries the slot's
+    pre-computed XLA-side randomness (makeup: the eviction position drawn
+    with the pre-append counts; breakup: the randint_excluding fresh
+    peer), `limit` is fanin (makeup) or fanout (breakup).  Returns
+    (friends, cnt, reply) with reply = dst where a message must be sent,
+    -1 elsewhere -- exactly where(mask, value, -1), so callers recover
+    the decision mask as reply >= 0 and the write-time count as its
+    sum."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    n, k = int(friends.shape[0]), int(friends.shape[1])
+    kern = _negotiate_kernel(kind, n, k, int(limit),
+                             max(1, _slot_block()))
+    friends, cnt, reply = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(friends.shape, friends.dtype),
+                   jax.ShapeDtypeStruct(cnt.shape, cnt.dtype),
+                   jax.ShapeDtypeStruct(cnt.shape, I32)],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=ip,
+    )(friends, cnt, src.astype(I32), has.astype(I32), draw.astype(I32))
+    return friends, cnt, reply
+
+
+# ---------------------------------------------------------------------------
+# Fused bootstrap request round: needNewFriend append + write-time count.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _request_kernel(n: int, k: int, fanout: int, blk: int):
+    blk_eff, nfull, ragged, tail_start = _row_blocks(n, blk)
+
+    def block(start, first_valid, fr_ref, cnt_ref, w_ref, em_ref, c_ref):
+        rows = start + jax.lax.broadcasted_iota(I32, (blk_eff,), 0)
+        valid = rows >= first_valid
+        fr = fr_ref[pl.ds(start, blk_eff), :]
+        cnt = cnt_ref[pl.ds(start, blk_eff)]
+        w = w_ref[pl.ds(start, blk_eff)]
+        under = (cnt < fanout) & valid
+        iok = jax.lax.broadcasted_iota(I32, (blk_eff, k), 1)
+        oh_app = iok == jnp.minimum(cnt, k - 1)[:, None]
+        fr_ref[pl.ds(start, blk_eff), :] = jnp.where(
+            oh_app & under[:, None], w[:, None], fr)
+        cnt_ref[pl.ds(start, blk_eff)] = cnt + under.astype(I32)
+        em = jnp.where(under, w, -1)
+        old = em_ref[pl.ds(start, blk_eff)]
+        em_ref[pl.ds(start, blk_eff)] = jnp.where(valid, em, old)
+        c_ref[0] = c_ref[0] + under.sum(dtype=I32)
+
+    def kernel(_, __, w_ref, fr_ref, cnt_ref, em_ref, c_ref):
+        c_ref[0] = jnp.int32(0)
+        args = (fr_ref, cnt_ref, w_ref, em_ref, c_ref)
+        jax.lax.fori_loop(
+            0, nfull,
+            lambda i, _: (block(i * blk_eff, jnp.int32(0), *args), 0)[1],
+            0)
+        if ragged:
+            block(jnp.int32(tail_start), jnp.int32(nfull * blk_eff),
+                  *args)
+
+    return kernel
+
+
+def fused_request_round(friends, cnt, w, *, fanout: int, interpret=None):
+    """The per-round bootstrap block (simulator.go:95-106) as one pass:
+    every row still under fanout appends its pre-drawn self-patched
+    needNewFriend target `w` and emits the request, with the write-time
+    emission count (the PR-3 dead-skip bookkeeping) accumulated
+    in-register instead of a separate n-wide reduction.  Returns
+    (friends, cnt, boot_em, boot_cnt) -- boot_cnt a scalar int32, the
+    integer mask sum (commutes across blocks, bit-identical to
+    under.sum())."""
+    if interpret is None:
+        interpret = _default_interpret()
+    ip = _interpret_param(interpret)
+    n, k = int(friends.shape[0]), int(friends.shape[1])
+    kern = _request_kernel(n, k, int(fanout), max(1, _slot_block()))
+    friends, cnt, boot_em, c = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(friends.shape, friends.dtype),
+                   jax.ShapeDtypeStruct(cnt.shape, cnt.dtype),
+                   jax.ShapeDtypeStruct(cnt.shape, I32),
+                   jax.ShapeDtypeStruct((1,), I32)],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=ip,
+    )(friends, cnt, w.astype(I32))
+    return friends, cnt, boot_em, c[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused hosted-chunk occupancy: every emission row popcounted in one pass.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _occupancy_kernel(r: int, m: int, blk: int):
+    blk_eff = min(blk, m)
+    nfull = m // blk_eff
+    tail_start = m - blk_eff
+
+    def part(start, first_valid, mat_ref):
+        cols = start + jax.lax.broadcasted_iota(I32, (r, blk_eff), 1)
+        live = (mat_ref[:, pl.ds(start, blk_eff)] >= 0) \
+            & (cols >= first_valid)
+        return live.sum(axis=1, dtype=I32)
+
+    def kernel(mat_ref, occ_ref):
+        acc = jax.lax.fori_loop(
+            0, nfull,
+            lambda j, a: a + part(j * blk_eff, jnp.int32(0), mat_ref),
+            jnp.zeros((r,), I32))
+        if m % blk_eff:
+            acc = acc + part(jnp.int32(tail_start),
+                             jnp.int32(nfull * blk_eff), mat_ref)
+        occ_ref[...] = acc
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _occupancy_call(r: int, m: int, blk: int, interpret: bool):
+    """Jitted per-shape wrapper: run() calls this from the host loop, so
+    the pallas_call must not re-trace per round."""
+    kern = _occupancy_kernel(r, m, blk)
+    call = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((r,), I32),
+        interpret=_interpret_param(interpret),
+    )
+    return jax.jit(call)
+
+
+def fused_hosted_chunk(mat, *, interpret=None):
+    """Per-rung occupancy for the adaptive hosted delivery ladder: the
+    live-entry total of EVERY row of an emission matrix int32[r, m] in
+    one fused pass -- one device call + one transfer where the host
+    ladder paid a jitted popcount round-trip per row.  Per-row integer
+    block sums, so the totals are bit-identical to (row >= 0).sum() and
+    the ladder re-selects exactly the same widths.  Returns occupancy
+    int32[r]."""
+    if interpret is None:
+        interpret = _default_interpret()
+    r, m = int(mat.shape[0]), int(mat.shape[1])
+    return _occupancy_call(r, m, max(1, _chunk_block()),
+                           bool(interpret))(mat)
+
+
+# ---------------------------------------------------------------------------
+# Capability probes (one-shot, threaded out of ambient traces -- the PR-6
+# pattern: config.phase1_kernel_resolved is read at model-build time).
+# ---------------------------------------------------------------------------
+
+
+def _probe_case(interpret: bool) -> str:
+    """Tiny concrete parity cases for every fused pass vs its XLA form;
+    '' on bit-identical results, else a named reason.  Runs on a fresh
+    thread: trace contexts are thread-local, so the comparisons stay
+    eager even when the (lru_cached) gate fires mid-trace."""
+    import threading
+
+    out: list = []
+
+    def run():
+        try:
+            out.append(_probe_case_impl(interpret))
+        except Exception as e:  # noqa: BLE001 - reported as the reason
+            out.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    return out[0]
+
+
+def _probe_case_impl(interpret: bool) -> str:
+    from gossip_simulator_tpu.models import overlay as ov
+    from gossip_simulator_tpu.utils import rng as _rng
+
+    # A small state with every row class: empty, under-fanin, at-fanout,
+    # over-fanout, and src hits both present and absent friends.  n=37 is
+    # deliberately ragged against every slot_block candidate.
+    n, k, fanout, fanin = 37, 5, 3, 3
+    key = jax.random.PRNGKey(7)
+    kc, kf, ks, kk = jax.random.split(key, 4)
+    cnt = jax.random.randint(kc, (n,), 0, k + 1, dtype=I32)
+    fr = jax.random.randint(kf, (n, k), 0, n, dtype=I32)
+    iok = jnp.arange(k, dtype=I32)[None, :]
+    fr = jnp.where(iok < cnt[:, None], fr, -1)
+    src = jax.random.randint(ks, (n,), -2, n, dtype=I32)
+    has = src >= 0
+    src = jnp.where(has, src, 0)
+    ids = jnp.arange(n, dtype=I32)
+
+    # --- breakup: fused vs process_breakup_slot -------------------------
+    xf, xc, xnf, xrp = ov.process_breakup_slot(n, fanout, fr, cnt, src,
+                                               has, ids, kk)
+    nf = _rng.randint_excluding(kk, n, (n,), src, ids)
+    ff, fc, rep = fused_negotiate(fr, cnt, src, has, nf, kind="breakup",
+                                  limit=fanout, interpret=interpret)
+    if not (bool((ff == xf).all()) and bool((fc == xc).all())
+            and bool((rep == jnp.where(xrp, xnf, -1)).all())):
+        return "fused breakup negotiation diverged from the XLA reference"
+
+    # --- makeup: fused vs process_makeup_slot ---------------------------
+    xf, xc, xv, xev = ov.process_makeup_slot(fanin, fr, cnt, src, has, kk)
+    vpos = jax.random.randint(kk, cnt.shape, 0, jnp.maximum(cnt, 1),
+                              dtype=I32)
+    ff, fc, rep = fused_negotiate(fr, cnt, src, has, vpos, kind="makeup",
+                                  limit=fanin, interpret=interpret)
+    if not (bool((ff == xf).all()) and bool((fc == xc).all())
+            and bool((rep == jnp.where(xev, xv, -1)).all())):
+        return "fused makeup negotiation diverged from the XLA reference"
+
+    # --- bootstrap request: fused vs the masked-append block ------------
+    kb = jax.random.fold_in(kk, _rng.OP_BOOTSTRAP)
+    w = jax.random.randint(kb, (n,), 0, n, dtype=I32)
+    w = jnp.where(w == ids, (w + 1) % n, w)
+    under = cnt < fanout
+    xf = ov._col_set(fr, jnp.minimum(cnt, k - 1), w, under)
+    xc = cnt + under.astype(I32)
+    xem = jnp.where(under, w, -1)
+    ff, fc, fem, fbc = fused_request_round(fr, cnt, w, fanout=fanout,
+                                           interpret=interpret)
+    if not (bool((ff == xf).all()) and bool((fc == xc).all())
+            and bool((fem == xem).all())
+            and int(fbc) == int(under.sum())):
+        return "fused bootstrap request diverged from the XLA reference"
+
+    # --- hosted occupancy vs the per-row popcount -----------------------
+    mat = jnp.where(jax.random.uniform(kf, (4, 133)) < 0.4,
+                    jax.random.randint(ks, (4, 133), 0, n, dtype=I32), -1)
+    occ = fused_hosted_chunk(mat, interpret=interpret)
+    if not bool((occ == (mat >= 0).sum(axis=1, dtype=I32)).all()):
+        return "fused hosted occupancy diverged from the XLA popcount"
+    return ""
+
+
+@functools.lru_cache(maxsize=1)
+def interpret_unsupported() -> str:
+    """'' when every fused phase-1 pass runs (and matches XLA) in
+    interpret mode on this jax build; else the reason (the CPU-CI
+    gate)."""
+    try:
+        return _probe_case(interpret=True)
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        return f"{type(e).__name__}: {e}"
+
+
+@functools.lru_cache(maxsize=1)
+def tpu_unsupported() -> str:
+    """'' when the fused passes lower AND pass on-device parity on a real
+    TPU backend; else the named reason (the auto gate policy)."""
+    if jax.default_backend() != "tpu":
+        return ("no TPU backend "
+                f"(jax.default_backend()={jax.default_backend()!r})")
+    try:
+        return _probe_case(interpret=False)
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        return f"{type(e).__name__}: {e}"
+
+
+def kernel_unavailable_reason() -> str:
+    """'' when `-phase1-kernel pallas` can run on THIS host (natively on
+    TPU, interpret mode elsewhere); else the named reason."""
+    if jax.default_backend() == "tpu":
+        return tpu_unsupported()
+    return interpret_unsupported()

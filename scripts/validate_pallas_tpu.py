@@ -31,6 +31,14 @@ Checks (all on freshly generated tables):
   (including chunk-split commutation), and the joint multi-rumor
   deposit.  PRNG-free like the deliver checks; --interpret merges
   megakernel_interpret, a TPU pass merges megakernel_tpu.
+* overlay kernel (ISSUE 19, run_overlay_kernel_checks): the
+  -phase1-kernel fused passes (ops/pallas_overlay_kernel) bit-identical
+  to the overlay slot chains -- fused_negotiate vs
+  process_makeup_slot/process_breakup_slot on a dense random state plus
+  the probe's ragged corner set, fused_request_round vs the bootstrap
+  append block, fused_hosted_chunk vs the per-row popcount.  RNG draws
+  stay XLA-side by design; --interpret merges a DATED overlay_interpret
+  verdict, a TPU pass merges overlay_tpu (queued in BENCH.md).
 
 Run: python scripts/validate_pallas_tpu.py [--out PALLAS_VALIDATION.json]
      python scripts/validate_pallas_tpu.py --interpret   # CPU deliver-only
@@ -369,6 +377,98 @@ def run_megakernel_checks() -> dict:
     }
 
 
+def run_overlay_kernel_checks(date: str | None = None) -> dict:
+    """Bit-identity of the phase-1 fused passes against the overlay slot
+    chains they replace (ops/pallas_overlay_kernel vs
+    models/overlay.process_*_slot + the bootstrap block + the hosted
+    ladder popcount).  The draws (randint_excluding fresh peer, eviction
+    position, needNewFriend target) are computed XLA-side on the
+    identical keys, so the assertions hold natively on TPU and in
+    interpret mode on CPU."""
+    import jax.numpy as jnp
+
+    from gossip_simulator_tpu.models import overlay as ov
+    from gossip_simulator_tpu.ops import pallas_overlay_kernel as pok
+    from gossip_simulator_tpu.utils import rng as _rng
+
+    mode = "tpu" if jax.default_backend() == "tpu" else "interpret"
+    why = pok.kernel_unavailable_reason()
+    base = {"mode": mode}
+    if date:
+        base["date"] = date
+    if why:
+        return {**base, "skipped": why}
+    I32 = jnp.int32
+    checks = []
+
+    def add(name, ok, **detail):
+        checks.append({"name": name, "ok": bool(ok), **detail})
+
+    # The one-shot probe asserts all three fused passes on a ragged
+    # every-row-class state; record its verdict as a check.
+    probe = (pok.interpret_unsupported() if mode == "interpret"
+             else pok.tpu_unsupported())
+    add("probe_parity", probe == "", reason=probe)
+
+    # A second, denser state (n a multiple of the block width, every has
+    # lane live) so both the full-block and the overlap-tail schedules
+    # are exercised across the checks.
+    n, k, fanout, fanin = 1024, 6, 3, 3
+    key = jax.random.PRNGKey(19)
+    kc, kf, ks, kk = jax.random.split(key, 4)
+    cnt = jax.random.randint(kc, (n,), 0, k + 1, dtype=I32)
+    fr = jax.random.randint(kf, (n, k), 0, n, dtype=I32)
+    fr = jnp.where(jnp.arange(k, dtype=I32)[None, :] < cnt[:, None],
+                   fr, -1)
+    src = jax.random.randint(ks, (n,), 0, n, dtype=I32)
+    has = jax.random.uniform(jax.random.fold_in(ks, 1), (n,)) < 0.8
+    ids = jnp.arange(n, dtype=I32)
+
+    xf, xc, xnf, xrp = ov.process_breakup_slot(n, fanout, fr, cnt, src,
+                                               has, ids, kk)
+    nf = _rng.randint_excluding(kk, n, (n,), src, ids)
+    ff, fc, rep = pok.fused_negotiate(fr, cnt, src, has, nf,
+                                      kind="breakup", limit=fanout)
+    add("negotiate_breakup_parity",
+        bool((ff == xf).all()) and bool((fc == xc).all())
+        and bool((rep == jnp.where(xrp, xnf, -1)).all()))
+
+    xf, xc, xv, xev = ov.process_makeup_slot(fanin, fr, cnt, src, has, kk)
+    vpos = jax.random.randint(kk, cnt.shape, 0, jnp.maximum(cnt, 1),
+                              dtype=I32)
+    ff, fc, rep = pok.fused_negotiate(fr, cnt, src, has, vpos,
+                                      kind="makeup", limit=fanin)
+    add("negotiate_makeup_parity",
+        bool((ff == xf).all()) and bool((fc == xc).all())
+        and bool((rep == jnp.where(xev, xv, -1)).all()))
+
+    w = jax.random.randint(jax.random.fold_in(kk, 2), (n,), 0, n,
+                           dtype=I32)
+    w = jnp.where(w == ids, (w + 1) % n, w)
+    under = cnt < fanout
+    xf = ov._col_set(fr, jnp.minimum(cnt, k - 1), w, under)
+    ff, fc, fem, fbc = pok.fused_request_round(fr, cnt, w, fanout=fanout)
+    add("request_round_parity",
+        bool((ff == xf).all())
+        and bool((fc == cnt + under.astype(I32)).all())
+        and bool((fem == jnp.where(under, w, -1)).all())
+        and int(fbc) == int(under.sum()))
+
+    mat = jnp.where(jax.random.uniform(kf, (16, 2000)) < 0.3,
+                    jax.random.randint(ks, (16, 2000), 0, n, dtype=I32),
+                    -1)
+    occ = pok.fused_hosted_chunk(mat)
+    add("hosted_occupancy_parity",
+        bool((occ == (mat >= 0).sum(axis=1, dtype=I32)).all()))
+
+    return {
+        **base,
+        "device": jax.devices()[0].device_kind,
+        "checks": checks,
+        "all_pass": all(c["ok"] for c in checks),
+    }
+
+
 def _merge_out(path: str, updates: dict) -> dict:
     """Merge `updates` into the JSON artifact at `path` (preserving any
     recorded sections -- e.g. the CPU --interpret verdict must not erase
@@ -395,16 +495,21 @@ def main() -> int:
                     help="run only the (PRNG-free) delivery-kernel checks "
                          "in interpret mode -- valid on CPU hosts; the "
                          "verdict is merged into --out")
+    ap.add_argument("--date", default="2026-08-07",
+                    help="stamp for the merged interpret/TPU verdicts")
     args = ap.parse_args()
     if args.interpret:
         result = run_deliver_checks()
         mega = run_megakernel_checks()
+        ovl = run_overlay_kernel_checks(date=args.date)
         _merge_out(args.out, {"deliver_interpret": result,
-                              "megakernel_interpret": mega})
+                              "megakernel_interpret": mega,
+                              "overlay_interpret": ovl})
         print(json.dumps({"deliver_interpret": result,
-                          "megakernel_interpret": mega}))
-        return 0 if (result.get("all_pass")
-                     and mega.get("all_pass")) else 1
+                          "megakernel_interpret": mega,
+                          "overlay_interpret": ovl}))
+        return 0 if (result.get("all_pass") and mega.get("all_pass")
+                     and ovl.get("all_pass")) else 1
     if jax.default_backend() != "tpu":
         print(json.dumps({"skipped": "no TPU present; interpret-mode PRNG "
                                      "validates nothing (use --interpret "
@@ -413,12 +518,13 @@ def main() -> int:
     result = run_checks()
     deliver = run_deliver_checks()
     mega = run_megakernel_checks()
+    ovl = run_overlay_kernel_checks(date=args.date)
     _merge_out(args.out, {**result, "deliver_tpu": deliver,
-                          "megakernel_tpu": mega})
+                          "megakernel_tpu": mega, "overlay_tpu": ovl})
     print(json.dumps({**result, "deliver_tpu": deliver,
-                      "megakernel_tpu": mega}))
+                      "megakernel_tpu": mega, "overlay_tpu": ovl}))
     return 0 if (result["all_pass"] and deliver.get("all_pass")
-                 and mega.get("all_pass")) else 1
+                 and mega.get("all_pass") and ovl.get("all_pass")) else 1
 
 
 if __name__ == "__main__":

@@ -17,6 +17,12 @@ import sys
 def pytest_configure(config):
     from gossip_simulator_tpu.utils import jaxsetup
 
+    # The tier-1 sweep runs -m 'not slow' under a hard wall-clock budget
+    # (ROADMAP.md); slow-marked tests still run in their explicit
+    # tier1.yml steps, which use no marker filter.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the budgeted tier-1 sweep; "
+        "covered by an explicit tier1.yml step")
     if os.environ.get("_GOSSIP_TEST_REEXEC") == "1":
         jaxsetup.setup()
         return

@@ -69,6 +69,11 @@ PRE_REGISTRY_DEFAULTS = {
     # fused drain / receive-landing passes; TPU-only, "never"-persist.
     "pallas_megakernel.drain_block": 8,
     "pallas_megakernel.recv_block": 8,
+    # Phase-1 overlay megakernel (ISSUE 19): serial block shapes for the
+    # fused negotiate/request and hosted-occupancy passes; TPU-only,
+    # "never"-persist.
+    "pallas_overlay.slot_block": 512,
+    "pallas_overlay.chunk_block": 1024,
     "config.overlay_ticks_auto_max": 10_000_000,
 }
 

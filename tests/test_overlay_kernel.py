@@ -1,0 +1,317 @@
+"""Phase-1 overlay megakernel (-phase1-kernel, ISSUE 19).
+
+Same three-layer shape as test_megakernel (the PR-18 gate this one
+twins), all in interpret mode on CPU:
+
+* Unit parity: each fused pass against the overlay chain it replaces --
+  fused_negotiate vs process_breakup_slot / process_makeup_slot (reply
+  encoding included), fused_request_round vs the bootstrap append block,
+  fused_hosted_chunk vs the per-row popcount -- on both a ragged and a
+  block-aligned state.
+* Trajectory pins + A/B: `-phase1-kernel xla` must reproduce the
+  pre-kernel trajectories bit for bit (hashes below were captured on the
+  commit before this PR; phase-1 overlay windows AND the downstream
+  gossip phase both hash), and pallas must match xla on every combo:
+  both engines (event/ring), both overlay timing models (rounds/ticks),
+  S=1/S=8, the static-boot gate, the ticks spill corner (lowered memory
+  band) and the split-round band (SPLIT_ROUND_MIN_ROWS=0) -- whose pin
+  equals the fused round's by the split==fused contract.
+* Gate policy: auto falls back off-TPU with a named reason, explicit
+  xla never probes, explicit pallas resolves through the interpret
+  probe, bogus values are rejected at validate() time, and checkpoints
+  resume across gates in both directions.
+"""
+
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import gossip_simulator_tpu.config as config_mod
+from gossip_simulator_tpu.config import Config
+from gossip_simulator_tpu.models import overlay as ov
+from gossip_simulator_tpu.models import overlay_ticks as ot
+from gossip_simulator_tpu.ops import pallas_overlay_kernel as pok
+from gossip_simulator_tpu.utils import checkpoint
+from gossip_simulator_tpu.utils import rng as _rng
+
+I32 = jnp.int32
+
+needs_interpret = pytest.mark.skipif(
+    bool(pok.interpret_unsupported()),
+    reason="pallas interpret mode unsupported on this host's jax build: "
+           + pok.interpret_unsupported())
+
+ROUNDS = dict(graph="overlay", overlay_mode="rounds", fanout=5, seed=9,
+              backend="jax", progress=False, coverage_target=0.9)
+TICKS = dict(graph="overlay", overlay_mode="ticks", fanout=5, seed=9,
+             backend="jax", progress=False, coverage_target=0.9)
+
+
+def _fingerprint(cfg, max_windows=3000):
+    """Per-window trajectory hash over BOTH phases: every overlay window's
+    (makeups, breakups) pair -- the phase-1 surface the kernel fuses --
+    then the gossip phase's stats rows (the membership the overlay built
+    feeds the epidemic, so a single flipped friend shows up here too)."""
+    from gossip_simulator_tpu.backends import make_stepper
+
+    s = make_stepper(cfg)
+    s.init()
+    rows = []
+    for _ in range(max_windows):
+        mk, bk, q = s.overlay_window()
+        rows.append((mk, bk))
+        if q:
+            break
+    s.seed()
+    for _ in range(400):
+        st = s.gossip_window()
+        rows.append((st.round, st.total_received, st.total_message,
+                     st.total_crashed, st.total_removed))
+        if st.coverage >= cfg.coverage_target or s.exhausted:
+            break
+    return hashlib.sha256(json.dumps(rows).encode()).hexdigest()[:16]
+
+
+# --------------------------------------------------------------------------
+# Unit parity: fused passes vs the overlay chains they replace
+# --------------------------------------------------------------------------
+
+def _random_state(n, k, seed):
+    """A state with every row class: empty, under-fanin, at-fanout,
+    over-fanout; src hits both present and absent friends, with dead
+    mailbox lanes."""
+    key = jax.random.PRNGKey(seed)
+    kc, kf, ks, kk = jax.random.split(key, 4)
+    cnt = jax.random.randint(kc, (n,), 0, k + 1, dtype=I32)
+    fr = jax.random.randint(kf, (n, k), 0, n, dtype=I32)
+    fr = jnp.where(jnp.arange(k, dtype=I32)[None, :] < cnt[:, None],
+                   fr, -1)
+    src = jax.random.randint(ks, (n,), -2, n, dtype=I32)
+    has = src >= 0
+    src = jnp.where(has, src, 0)
+    return fr, cnt, src, has, jnp.arange(n, dtype=I32), kk
+
+
+# n=37 is ragged against every slot_block candidate (overlap-tail
+# schedule); n=1024 divides them all (pure full-block schedule).
+@needs_interpret
+@pytest.mark.parametrize("n", [37, 1024])
+def test_negotiate_breakup_parity(n):
+    k, fanout = 5, 3
+    fr, cnt, src, has, ids, kk = _random_state(n, k, seed=7)
+    xf, xc, xnf, xrp = ov.process_breakup_slot(n, fanout, fr, cnt, src,
+                                               has, ids, kk)
+    ff, fc, rep, rp = ov.process_breakup_slot_pallas(
+        n, fanout, fr, cnt, src, has, ids, kk)
+    assert (ff == xf).all() and (fc == xc).all()
+    assert (rep == jnp.where(xrp, xnf, -1)).all()
+    assert (rp == xrp).all()
+
+
+@needs_interpret
+@pytest.mark.parametrize("n", [37, 1024])
+def test_negotiate_makeup_parity(n):
+    k, fanin = 5, 3
+    fr, cnt, src, has, ids, kk = _random_state(n, k, seed=8)
+    xf, xc, xv, xev = ov.process_makeup_slot(fanin, fr, cnt, src, has, kk)
+    ff, fc, rep, ev = ov.process_makeup_slot_pallas(
+        fanin, fr, cnt, src, has, kk)
+    assert (ff == xf).all() and (fc == xc).all()
+    assert (rep == jnp.where(xev, xv, -1)).all()
+    assert (ev == xev).all()
+
+
+@needs_interpret
+@pytest.mark.parametrize("n", [37, 1024])
+def test_request_round_parity(n):
+    k, fanout = 5, 3
+    fr, cnt, _, _, ids, kk = _random_state(n, k, seed=9)
+    kb = jax.random.fold_in(kk, _rng.OP_BOOTSTRAP)
+    w = jax.random.randint(kb, (n,), 0, n, dtype=I32)
+    w = jnp.where(w == ids, (w + 1) % n, w)
+    under = cnt < fanout
+    xf = ov._col_set(fr, jnp.minimum(cnt, k - 1), w, under)
+    ff, fc, fem, fbc = pok.fused_request_round(fr, cnt, w, fanout=fanout,
+                                               interpret=True)
+    assert (ff == xf).all()
+    assert (fc == cnt + under.astype(I32)).all()
+    assert (fem == jnp.where(under, w, -1)).all()
+    assert int(fbc) == int(under.sum())
+
+
+@needs_interpret
+@pytest.mark.parametrize("m", [133, 2048])
+def test_hosted_occupancy_parity(m):
+    rng = np.random.default_rng(19)
+    mat = jnp.asarray(np.where(rng.random((6, m)) < 0.4,
+                               rng.integers(0, 999, (6, m)), -1), I32)
+    occ = pok.fused_hosted_chunk(mat, interpret=True)
+    assert (occ == (mat >= 0).sum(axis=1, dtype=I32)).all()
+
+
+# --------------------------------------------------------------------------
+# Trajectory pins + A/B: xla must reproduce pre-PR runs bit for bit,
+# pallas must match xla.  Hashes captured on the commit before this PR.
+# --------------------------------------------------------------------------
+
+PINNED_COMBOS = {
+    "rounds_jax_event": ("04e0ec088bbd7540",
+                         dict(**ROUNDS, n=3000, engine="event")),
+    "rounds_jax_ring": ("dc19a8b4a1264b0e",
+                        dict(**ROUNDS, n=3000, engine="ring")),
+    "rounds_sharded_event": ("db128648b850ae90",
+                             dict(**{**ROUNDS, "backend": "sharded"},
+                                  n=2400, engine="event",
+                                  exchange_pipeline="off")),
+    "rounds_sharded_ring": ("901bc268996e9676",
+                            dict(**{**ROUNDS, "backend": "sharded"},
+                                 n=2400, engine="ring")),
+    "rounds_static_boot": ("b1559dda440276fc",
+                           dict(**ROUNDS, n=3000, engine="event",
+                                overlay_static_boot="on")),
+    "ticks_jax_event": ("14236e8dca90cea8",
+                        dict(**TICKS, n=2000, engine="event")),
+    "ticks_jax_ring": ("18ba4a0566f0662c",
+                       dict(**TICKS, n=2000, engine="ring")),
+    "ticks_sharded_event": ("abceb8eca86a515e",
+                            dict(**{**TICKS, "backend": "sharded"},
+                                 n=2400, engine="event",
+                                 exchange_pipeline="off")),
+}
+
+
+# The tier-1 sweep (-m 'not slow') runs under a hard wall-clock budget,
+# so it keeps one representative pin per surface (rounds, ticks,
+# static-boot -- all jax/event); the ring/sharded pins ride the explicit
+# "Phase-1 overlay megakernel parity" tier1.yml step, which runs this
+# file with no marker filter.
+_SWEEP_COMBOS = {"rounds_jax_event", "ticks_jax_event", "rounds_static_boot"}
+
+
+@needs_interpret
+@pytest.mark.parametrize(
+    "name",
+    [n if n in _SWEEP_COMBOS else pytest.param(n, marks=pytest.mark.slow)
+     for n in sorted(PINNED_COMBOS)])
+def test_engine_fingerprint_pin_and_ab(name):
+    pin, kw = PINNED_COMBOS[name]
+    fx = _fingerprint(Config(**kw, phase1_kernel="xla").validate())
+    assert fx == pin, f"{name}: -phase1-kernel xla drifted from pre-PR"
+    fpal = _fingerprint(Config(**kw, phase1_kernel="pallas").validate())
+    assert fpal == fx, f"{name}: pallas != xla"
+
+
+TICKS_SPILL_PIN = "34fbce9b5d352777"
+
+
+@needs_interpret
+@pytest.mark.slow
+def test_ticks_spill_corner_pin_and_ab(monkeypatch):
+    """The ticks memory band (slot-major drain + lossless spill) at CPU
+    scale: lowered band constants, the house pattern of
+    test_overlay_phase1.  The pin was captured pre-PR under the same
+    lowered constants."""
+    monkeypatch.setattr(ot, "TICKS_SLOTMAJOR_MIN_ROWS", 1000)
+    monkeypatch.setattr(config_mod, "MAILBOX_CAP_MEMORY_BAND", 1000)
+    kw = dict(**TICKS, n=2000, engine="event")
+    fx = _fingerprint(Config(**kw, phase1_kernel="xla").validate())
+    assert fx == TICKS_SPILL_PIN, "spill corner drifted from pre-PR"
+    fpal = _fingerprint(Config(**kw, phase1_kernel="pallas").validate())
+    assert fpal == fx, "spill corner: pallas != xla"
+
+
+SPLIT_ROUND_PIN = "04e0ec088bbd7540"  # == rounds_jax_event (split==fused)
+
+
+@needs_interpret
+def test_split_round_corner_pin_and_ab(monkeypatch):
+    """The split-round band (host-driven hosted delivery -- where the
+    fused occupancy pass replaces the per-row popcount round-trips) at
+    CPU scale.  Its pin EQUALS the fused round's: split==fused is the
+    standing bit-identity contract this corner re-pins under the new
+    gate."""
+    monkeypatch.setattr(ov, "SPLIT_ROUND_MIN_ROWS", 0)
+    kw = dict(**ROUNDS, n=3000, engine="event", compact_chunk=256)
+    fx = _fingerprint(Config(**kw, phase1_kernel="xla").validate())
+    assert fx == SPLIT_ROUND_PIN, "split corner drifted from pre-PR"
+    fpal = _fingerprint(Config(**kw, phase1_kernel="pallas").validate())
+    assert fpal == fx, "split corner: pallas != xla"
+
+
+# --------------------------------------------------------------------------
+# Cross-gate checkpoint interop: the gate changes no state layout
+# --------------------------------------------------------------------------
+
+@needs_interpret
+@pytest.mark.parametrize(
+    "first,second",
+    [("xla", "pallas"),
+     pytest.param("pallas", "xla", marks=pytest.mark.slow)],
+    ids=["xla_to_pallas", "pallas_to_xla"])
+def test_cross_gate_checkpoint_resume(tmp_path, first, second):
+    from gossip_simulator_tpu.backends import make_stepper
+
+    kw = dict(**ROUNDS, n=2000, engine="event")
+
+    def boot(cfg):
+        s = make_stepper(cfg)
+        s.init()
+        while not s.overlay_window()[2]:
+            pass
+        s.seed()
+        return s
+
+    s = boot(Config(**kw, phase1_kernel=first).validate())
+    for _ in range(3):
+        s.gossip_window()
+    mid = s.stats()
+    path = checkpoint.save(str(tmp_path), 3, s.state_pytree(), mid)
+    reference = [s.gossip_window() for _ in range(3)]
+
+    s2 = boot(Config(**kw, phase1_kernel=second).validate())
+    tree, _ = checkpoint.load(path)
+    s2.load_state_pytree(tree)
+    assert s2.stats() == mid
+    for want in reference:
+        assert s2.gossip_window() == want
+
+
+# --------------------------------------------------------------------------
+# Gate policy
+# --------------------------------------------------------------------------
+
+def test_auto_falls_back_with_named_reason_off_tpu():
+    cfg = Config(n=2000, phase1_kernel="auto").validate()
+    if jax.default_backend() == "tpu":
+        pytest.skip("auto resolves to pallas on TPU")
+    assert cfg.phase1_kernel_resolved == "xla"
+    assert cfg.phase1_kernel_fallback_reason  # named, never silent
+    assert "TPU" in cfg.phase1_kernel_fallback_reason
+
+
+def test_xla_gate_never_probes():
+    cfg = Config(n=2000, phase1_kernel="xla").validate()
+    assert cfg.phase1_kernel_resolved == "xla"
+    assert cfg.phase1_kernel_fallback_reason == ""
+
+
+@needs_interpret
+def test_explicit_pallas_resolves_via_interpret():
+    cfg = Config(n=2000, phase1_kernel="pallas").validate()
+    assert cfg.phase1_kernel_resolved == "pallas"
+
+
+def test_validate_rejects_unknown_kernel():
+    with pytest.raises(ValueError, match="phase1_kernel"):
+        Config(n=2000, phase1_kernel="cuda").validate()
+
+
+def test_resolved_gates_reports_phase1():
+    gates = Config(n=2000, backend="jax").validate().resolved_gates()
+    assert gates["phase1_kernel"] in ("xla", "pallas", "unavailable")
+    gates = Config(n=2000, backend="native").validate().resolved_gates()
+    assert gates["phase1_kernel"] is None
