@@ -77,7 +77,12 @@ class _named_row:
 POOL_ERROR_MARKERS = ("UNAVAILABLE", "unreachable", "DEADLINE_EXCEEDED",
                       "failed to connect", "Connection refused",
                       "Socket closed", "RESOURCE_EXHAUSTED: Failed to "
-                      "allocate device")
+                      "allocate device",
+                      # Bounded jax.distributed join failure
+                      # (parallel/mesh.bounded_initialize, ISSUE 20): a
+                      # coordinator that never comes up is pool weather,
+                      # not a bench bug.
+                      "DistributedInitError")
 
 
 def is_pool_error(exc: BaseException) -> bool:
@@ -115,7 +120,7 @@ def pool_retry(fn, *args, name: str = "", retries: int = 3,
 # every dated skip record so a BENCH_SELF_rNN.json names WHICH session
 # failed to reach hardware, and diffed against queued_since below to
 # render how many consecutive sessions each queued row has waited.
-SESSION = "r19"
+SESSION = "r20"
 
 
 def session_number(tag: str) -> int:
@@ -201,6 +206,12 @@ QUEUED_HARDWARE_ROWS = (
              "against ROOFLINE.json's per-term floor (the fused pass is "
              "parity-pinned bit-identical on CPU but unmeasured on "
              "device)"},
+    {"row": "hostloss_50m_twins", "queued_since": "r20",
+     "capture": "capture_hostloss_50m",
+     "what": "50M supervised kill-drill vs undisturbed same-seed twin "
+             "(recovery_pause_ms against a real-scale snapshot + "
+             "Stats-exactness at scale; the CPU hostloss_recovery row "
+             "bounds only the /100 stand-in restore)"},
     {"row": "phase1_kernel_100m_twins", "queued_since": "r19",
      "capture": "capture_phase1_kernel_twins",
      "what": "100M two-phase -phase1-kernel xla-vs-pallas same-seed "
@@ -892,6 +903,95 @@ def capture_serve_elasticity(detail: dict, seed: int) -> None:
     detail["serve_elasticity"] = row
 
 
+def capture_hostloss_recovery(detail: dict, seed: int) -> None:
+    """Host-loss recovery row (ISSUE 20): a supervised run loses a
+    worker to the -chaos kill-worker drill mid-stream, restores the last
+    sha256-verified snapshot, and replays to convergence -- measuring
+    recovery_pause_ms (the wall-clock the service stood still across
+    detect -> restore -> reshard, the SLO a future perf round drives
+    down) next to the snapshot size that bounds it, with the exactness
+    invariant (shed == 0, every rumor delivered) asserted in the row
+    itself.  Scale-banded like the suite: 1M nodes on TPU, /100 on CPU
+    stand-in hosts."""
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils import checkpoint
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    import tempfile
+
+    n = 1_048_576 if jax.default_backend() == "tpu" else 10_485
+    t0 = time.perf_counter()
+    try:
+        with tempfile.TemporaryDirectory() as rd:
+            ck = os.path.join(rd, "ckpt")
+            cfg = Config(n=n, graph="kout", fanout=6, seed=seed,
+                         crashrate=0.0, droprate=0.0, delaylow=10,
+                         delayhigh=11, protocol="si", engine="event",
+                         backend="jax", rumors=8, traffic="stream",
+                         stream_rate=40, coverage_target=0.99,
+                         max_rounds=3000, progress=False,
+                         supervise=True, workers=2,
+                         chaos="kill-worker@1:3", checkpoint_every=2,
+                         checkpoint_dir=ck, run_dir=rd).validate()
+            res = run_simulation(cfg, printer=ProgressPrinter(enabled=False))
+            snap = checkpoint.latest(ck)
+            ckpt_bytes = os.path.getsize(snap) if snap else 0
+        row = {"n": n, "converged": res.converged,
+               "rumors_done": res.stats.rumors_done,
+               "shed": res.stats.shed,
+               "recovered_windows": res.recovered_windows,
+               "recovery_pause_ms": res.recovery_pause_ms,
+               "ckpt_bytes": ckpt_bytes,
+               "wall_s": round(time.perf_counter() - t0, 3)}
+        if res.stats.shed or res.stats.rumors_done != cfg.rumors:
+            row["error"] = "exact-recovery invariant violated"
+    except Exception as e:  # record, don't kill the bench line
+        row = {"error": repr(e)}
+    detail["hostloss_recovery"] = row
+
+
+def capture_hostloss_50m(detail: dict, seed: int) -> None:
+    """TPU-only 50M host-loss twin pair: the supervised kill-drill run
+    and its undisturbed twin at the SAME n/graph/seed, so the record
+    carries recovery_pause_ms against a real-scale snapshot (the CPU
+    hostloss_recovery row bounds only the /100 stand-in restore) plus
+    the Stats-exactness check at scale."""
+    from gossip_simulator_tpu.driver import run_simulation
+    from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+    import tempfile
+
+    def _pair() -> dict:
+        base = Config(n=50_000_000, graph="kout", fanout=6, seed=seed,
+                      crashrate=0.0, droprate=0.0, delaylow=10,
+                      delayhigh=11, protocol="si", engine="event",
+                      backend="jax", rumors=8, traffic="stream",
+                      stream_rate=40, coverage_target=0.99,
+                      max_rounds=3000, progress=False)
+        t0 = time.perf_counter()
+        with tempfile.TemporaryDirectory() as rd:
+            drilled = base.replace(
+                supervise=True, workers=2, chaos="kill-worker@1:3",
+                checkpoint_every=2,
+                checkpoint_dir=os.path.join(rd, "ckpt")).validate()
+            res = run_simulation(drilled,
+                                 printer=ProgressPrinter(enabled=False))
+        drill_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        twin = run_simulation(base.validate(),
+                              printer=ProgressPrinter(enabled=False))
+        return {"n": base.n,
+                "stats_exact": res.stats.to_dict() == twin.stats.to_dict(),
+                "recovered_windows": res.recovered_windows,
+                "recovery_pause_ms": res.recovery_pause_ms,
+                "shed": res.stats.shed,
+                "drill_wall_s": round(drill_s, 3),
+                "twin_wall_s": round(time.perf_counter() - t1, 3)}
+
+    detail["hostloss_50m_twins"] = pool_retry(_pair,
+                                              name="hostloss_50m_twins")
+
+
 def capture_multirumor_50m(detail: dict, seed: int) -> None:
     """TPU-only 50M twin pair: the single-rumor baseline and the R=16
     concurrent broadcast at the SAME n/graph/seed, so the record carries
@@ -1401,6 +1501,9 @@ def main() -> int:
         # Elastic serving row (ISSUE 11): forced widen+narrow reshard
         # pause + zero-loss invariant (skipped on single-device hosts).
         capture_serve_elasticity(result["detail"], args.seed)
+        # Host-loss recovery row (ISSUE 20): supervised kill drill,
+        # recovery pause vs snapshot size, exactness invariant.
+        capture_hostloss_recovery(result["detail"], args.seed)
         # Spatial-telemetry on/off twins (ISSUE 16): panels must cost
         # <= 5% wall clock and leave the trajectory untouched.
         capture_spatial_overhead(result["detail"], args.seed)
@@ -1429,6 +1532,9 @@ def main() -> int:
             # 50M single- vs multi-rumor twins: the measured marginal
             # cost of the rumor axis at scale (ISSUE 8).
             capture_multirumor_50m(result["detail"], args.seed)
+            # 50M supervised kill-drill vs undisturbed twin (ISSUE 20):
+            # recovery pause against a real-scale snapshot.
+            capture_hostloss_50m(result["detail"], args.seed)
             # 50M PushSum sharded-vs-jax twins (ISSUE 14): mass-payload
             # exchange cost + shard-invariance at scale.
             capture_pushsum_50m(result["detail"], args.seed)

@@ -40,6 +40,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     from gossip_simulator_tpu.utils import lifecycle
 
     lifecycle.install_signal_handlers()
+    if cfg.supervise and cfg.coordinator:
+        # Real multi-process supervision (ISSUE 20): this process never
+        # touches jax -- it spawns -workers CLI worker processes (the same
+        # argv with -distributed wiring, distributed/worker.py), monitors
+        # their heartbeats, and on host loss relaunches the survivors with
+        # -resume.  Dispatched before any jax setup on purpose.
+        from gossip_simulator_tpu.distributed import supervisor
+
+        return supervisor.run_supervisor(
+            cfg, sys.argv[1:] if argv is None else list(argv))
     silent = False
     if cfg.backend in ("jax", "sharded"):
         _maybe_reexec_for_cpu(argv)
@@ -56,16 +66,19 @@ def main(argv: Optional[list[str]] = None) -> int:
             # into one global runtime and the sharded backend's mesh spans
             # ALL processes' devices (SURVEY §5.8 multi-slice path).  Only
             # process 0 prints -- the totals are replicated everywhere.
+            # Bounded + retried (parallel/mesh.py): a bad address fails in
+            # -init-timeout-scaled seconds WITH the address named, instead
+            # of the opaque 60s gRPC hang.
             import jax
 
-            kw = {}
-            if cfg.coordinator:
-                kw["coordinator_address"] = cfg.coordinator
-            if cfg.num_processes > 0:
-                kw["num_processes"] = cfg.num_processes
-            if cfg.process_id >= 0:
-                kw["process_id"] = cfg.process_id
-            jax.distributed.initialize(**kw)
+            from gossip_simulator_tpu.parallel.mesh import bounded_initialize
+
+            bounded_initialize(
+                coordinator_address=cfg.coordinator or None,
+                num_processes=(cfg.num_processes
+                               if cfg.num_processes > 0 else None),
+                process_id=cfg.process_id if cfg.process_id >= 0 else None,
+                timeout_s=float(cfg.init_timeout_s))
             silent = jax.process_index() != 0
     # Context-managed printer: the JSONL log is flushed and closed even
     # when the run raises (metrics.ProgressPrinter.__exit__).

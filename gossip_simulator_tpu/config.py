@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import math
+import os
 from typing import Optional
 
 
@@ -286,6 +287,43 @@ class Config:
     coordinator: str = ""  # e.g. "host0:1234"
     num_processes: int = -1  # -1 = auto-detect
     process_id: int = -1  # -1 = auto-detect
+    # --- host-loss supervision (ISSUE 20; gossip_simulator_tpu/distributed/) --
+    # -supervise turns host death into a recoverable event.  Without
+    # -coordinator it is the single-process drillable mode: the live mesh's
+    # devices are partitioned into -workers logical workers, heartbeat
+    # beacons are stamped per window, and a detected loss tears the state
+    # down and restores the last provenance-checked snapshot onto the
+    # survivors (distributed/supervisor.py run_supervised).  With
+    # -coordinator it is the real flavor: the supervisor process spawns
+    # -workers CLI worker processes joined via jax.distributed, monitors
+    # exits + wall-clock beacon staleness, and relaunches survivors with
+    # -resume.  Requires checkpointing (recovery restores the last atomic
+    # snapshot).
+    supervise: bool = False
+    workers: int = 2
+    # Liveness beacon directory ("" = <checkpoint_dir>/heartbeats).  Each
+    # worker stamps worker_<rank>.json atomically once per poll window.
+    heartbeat_dir: str = ""
+    # Loss verdict threshold: a worker whose beacon lags this far behind
+    # (wall-clock in the real supervisor; the WINDOW_MS-scaled window lag
+    # in the drillable mode) is declared lost.
+    heartbeat_timeout_ms: int = 30000
+    # Injected host-loss drill: "kill-worker@W[:K]" / "stall-worker@W[:K]"
+    # kills (or silences the beacon of) worker W at gossip window K
+    # (default 6).  Requires -supervise; parse_chaos() below.
+    chaos: str = ""
+    # Recovery staleness gate: refuse to restore a snapshot more than this
+    # many windows behind the loss point (0 = no limit).  See
+    # utils/checkpoint.py verify_provenance.
+    recover_max_stale: int = 0
+    # Provenance token stamped into snapshot sidecars; recovery refuses a
+    # snapshot from a different run.  "" = generate one per run (the
+    # supervisor passes its own to every worker so relaunched survivors
+    # adopt the original run's snapshots).
+    run_id: str = ""
+    # Bounded jax.distributed.initialize: per-attempt timeout in seconds
+    # (3 exponential-backoff attempts; parallel/mesh.py bounded_initialize).
+    init_timeout_s: int = 60
     # Device-resident per-window telemetry (utils/telemetry.py): the fast-
     # path while_loops record the full per-window trajectory on device and
     # the driver replays it through the printer afterward -- so a progress-
@@ -541,6 +579,17 @@ class Config:
         gates, _Checkpointer._due) -- they drifted when each spelled it
         out (advisor r4)."""
         return bool(self.checkpoint_every and self.checkpoint_dir)
+
+    @property
+    def heartbeat_dir_resolved(self) -> str:
+        """Where liveness beacons live: the explicit -heartbeat-dir, else a
+        heartbeats/ subdir of the checkpoint dir (supervision requires
+        checkpointing, so the fallback always resolves under -supervise)."""
+        if self.heartbeat_dir:
+            return self.heartbeat_dir
+        if self.checkpoint_dir:
+            return os.path.join(self.checkpoint_dir, "heartbeats")
+        return ""
 
     @property
     def telemetry_enabled(self) -> bool:
@@ -1218,6 +1267,61 @@ class Config:
                     raise ValueError(
                         f"-process-id must be in [0, {self.num_processes}), "
                         f"got {self.process_id}")
+        if self.chaos and not self.supervise:
+            raise ValueError(
+                "-chaos is a supervision drill; it requires -supervise")
+        if self.workers < 1:
+            raise ValueError(f"-workers must be >= 1, got {self.workers}")
+        if self.heartbeat_timeout_ms < 1:
+            raise ValueError(
+                f"-heartbeat-timeout-ms must be >= 1, "
+                f"got {self.heartbeat_timeout_ms}")
+        if self.recover_max_stale < 0:
+            raise ValueError(
+                f"-recover-max-stale must be >= 0, "
+                f"got {self.recover_max_stale}")
+        if self.init_timeout_s < 1:
+            raise ValueError(
+                f"-init-timeout must be >= 1, got {self.init_timeout_s}")
+        if self.supervise:
+            if self.backend not in ("jax", "sharded"):
+                raise ValueError(
+                    "-supervise requires backend=jax or sharded (recovery "
+                    "rides the checkpoint/restore surface)")
+            if self.serve:
+                raise ValueError(
+                    "-supervise and -serve are exclusive phase-2 loops")
+            if self.distributed:
+                raise ValueError(
+                    "-supervise launches the -distributed workers itself; "
+                    "run the supervisor WITHOUT -distributed")
+            if self.resume:
+                raise ValueError(
+                    "-supervise manages resume itself (survivors relaunch "
+                    "with -resume); start the supervisor without it")
+            if not self.checkpointing_enabled:
+                raise ValueError(
+                    "-supervise requires -checkpoint-every and "
+                    "-checkpoint-dir: recovery restores the last atomic "
+                    "snapshot, so there must be one to restore")
+            if self.workers < 2:
+                raise ValueError(
+                    "-supervise needs -workers >= 2 (losing the only "
+                    "worker leaves no survivors to recover onto)")
+            if self.coordinator and self.backend != "sharded":
+                raise ValueError(
+                    "-supervise with -coordinator spawns -distributed "
+                    "workers, which require -backend sharded")
+            if self.coordinator and (self.num_processes != -1
+                                     or self.process_id != -1):
+                raise ValueError(
+                    "-supervise assigns -num-processes/-process-id to the "
+                    "workers it spawns; do not set them on the supervisor")
+            drill = parse_chaos(self.chaos)
+            if drill is not None and drill.worker >= self.workers:
+                raise ValueError(
+                    f"-chaos targets worker {drill.worker} but only "
+                    f"{self.workers} workers exist")
         if not 0.0 < self.coverage_target <= 1.0:
             raise ValueError(
                 f"coverage_target must be in (0,1], got {self.coverage_target}"
@@ -1276,6 +1380,46 @@ def parse_serve_force(spec: str) -> dict:
                 f"-serve-force window {w} given twice")
         out[w] = s
     return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosDrill:
+    """A parsed -chaos spec: inject `kind` against `worker` once the run
+    reaches gossip window `window`."""
+
+    kind: str  # "kill-worker" | "stall-worker"
+    worker: int
+    window: int
+
+
+def parse_chaos(spec: str) -> Optional[ChaosDrill]:
+    """Parse a `-chaos` drill spec "kill-worker@W[:K]" /
+    "stall-worker@W[:K]" (W = target worker rank, K = gossip window to
+    inject at, default 6).  Returns None for the empty spec; raises
+    ValueError on malformed ones."""
+    if not spec:
+        return None
+    try:
+        kind, rest = spec.strip().split("@")
+        if ":" in rest:
+            w_str, k_str = rest.split(":")
+        else:
+            w_str, k_str = rest, "6"
+        worker, window = int(w_str), int(k_str)
+    except ValueError:
+        raise ValueError(
+            f"bad -chaos spec {spec!r} (expected kill-worker@W[:K] or "
+            "stall-worker@W[:K], e.g. kill-worker@1:6)")
+    if kind not in ("kill-worker", "stall-worker"):
+        raise ValueError(
+            f"-chaos kind must be kill-worker or stall-worker, got {kind!r}")
+    if worker < 0:
+        raise ValueError(f"-chaos worker must be >= 0, got {worker}")
+    if window < 1:
+        raise ValueError(
+            f"-chaos window must be >= 1 (the drill fires after a full "
+            f"gossip window), got {window}")
+    return ChaosDrill(kind=kind, worker=worker, window=window)
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -1533,6 +1677,36 @@ def _build_parser() -> argparse.ArgumentParser:
                    type=int, default=d.num_processes)
     p.add_argument("-process-id", "--process-id", dest="process_id",
                    type=int, default=d.process_id)
+    p.add_argument("-supervise", "--supervise", action="store_true",
+                   help="host-loss supervision (distributed/supervisor.py): "
+                        "single-process drillable mode, or with "
+                        "-coordinator the real process-spawning supervisor")
+    p.add_argument("-workers", "--workers", type=int, default=d.workers,
+                   help="worker count under -supervise (logical device "
+                        "slices, or spawned processes with -coordinator)")
+    p.add_argument("-heartbeat-dir", "--heartbeat-dir", dest="heartbeat_dir",
+                   default=d.heartbeat_dir,
+                   help="liveness beacon directory "
+                        "(default: <checkpoint-dir>/heartbeats)")
+    p.add_argument("-heartbeat-timeout-ms", "--heartbeat-timeout-ms",
+                   dest="heartbeat_timeout_ms", type=int,
+                   default=d.heartbeat_timeout_ms,
+                   help="beacon lag before a worker is declared lost")
+    p.add_argument("-chaos", "--chaos", default=d.chaos,
+                   help="host-loss drill: kill-worker@W[:K] or "
+                        "stall-worker@W[:K] (worker W at gossip window K)")
+    p.add_argument("-recover-max-stale", "--recover-max-stale",
+                   dest="recover_max_stale", type=int,
+                   default=d.recover_max_stale,
+                   help="refuse recovery from a snapshot more than this "
+                        "many windows behind the loss point (0 = no limit)")
+    p.add_argument("-run-id", "--run-id", dest="run_id", default=d.run_id,
+                   help="checkpoint provenance token (default: generated "
+                        "per run; recovery refuses foreign snapshots)")
+    p.add_argument("-init-timeout", "--init-timeout", dest="init_timeout_s",
+                   type=int, default=d.init_timeout_s,
+                   help="jax.distributed.initialize per-attempt timeout "
+                        "in seconds (3 retried attempts)")
     return p
 
 

@@ -1,0 +1,32 @@
+"""Supervisor/worker runtime (ISSUE 20): host death as a recoverable event.
+
+Three layers, one per module:
+
+* ``heartbeat`` -- per-worker liveness beacons (one JSON file per worker,
+  stamped each poll window) plus the supervisor-side monitor that turns a
+  stale or missing beacon into a *named* loss verdict.
+* ``worker``    -- the worker side of the real multi-process deployment:
+  argv surgery that turns the supervisor's own command line into each
+  worker's ``-distributed`` command line, and the relaunch variant that
+  restarts the survivors on a narrower process set with ``-resume``.
+* ``supervisor`` -- both supervisor flavors.  ``run_supervised`` is the
+  drillable single-process loop (logical workers = device slices of the
+  live mesh; a ``-chaos kill-worker@W`` drill or a heartbeat lag tears the
+  state down and restores the last provenance-checked snapshot onto the
+  survivor mesh through serve.py's checkpoint -> reshard -> restore
+  sequence).  ``run_supervisor`` is the real process-spawning flavor
+  (workers joined via the bounded ``jax.distributed`` initialize in
+  parallel/mesh.py; SIGKILL'd or wedged workers are detected, the
+  collective job is torn down, and the survivors relaunch with -resume).
+
+Recovery is Stats-exact against an uninterrupted twin when the trajectory
+is shard-count invariant (no randomized legacy faults, single-value delay
+draw, or (window, global-id)-keyed scenario faults -- the same recipe the
+serve reshard twins pin), because the snapshot replays the deterministic
+schedule from the checkpoint window forward.
+"""
+
+from gossip_simulator_tpu.distributed.heartbeat import (  # noqa: F401
+    Beacon, Monitor)
+from gossip_simulator_tpu.distributed.supervisor import (  # noqa: F401
+    SupervisedOutcome, run_supervised, run_supervisor, survivor_shard_count)

@@ -1,0 +1,122 @@
+"""Per-worker liveness beacons (ISSUE 20).
+
+Each worker stamps one JSON file per poll window --
+``<dir>/worker_<rank>.json`` holding the worker's rank, the window it just
+finished, its pid and a wall-clock stamp.  The stamp is atomic (tmp +
+os.replace, the checkpoint idiom) so the monitor never reads a torn
+beacon.  Two detection predicates, one per deployment flavor:
+
+* ``Monitor.lagging(current_window)`` -- DETERMINISTIC window-lag check
+  for the single-process supervised loop: a logical worker whose beacon
+  is more than ``lag_windows`` poll windows behind the loop is lost.
+  Wall-clock-free, so the drill trajectories stay pinned.
+* ``Monitor.stale(now)`` -- wall-clock staleness for the real
+  multi-process supervisor, where a wedged worker keeps its process alive
+  but stops advancing windows.  A worker that never wrote a beacon is
+  NOT stale (it may still be compiling); process exit covers that case.
+
+Module stays jax-free: the real supervisor monitors workers before any
+jax runtime exists in its own process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from gossip_simulator_tpu.backends.base import WINDOW_MS
+
+
+def beacon_path(hb_dir: str, rank: int) -> str:
+    return os.path.join(hb_dir, f"worker_{rank:04d}.json")
+
+
+class Beacon:
+    """The worker side: stamp liveness once per poll window."""
+
+    def __init__(self, hb_dir: str, rank: int):
+        self.path = beacon_path(hb_dir, rank)
+        self.rank = rank
+        os.makedirs(hb_dir, exist_ok=True)
+
+    @classmethod
+    def for_cfg(cls, cfg) -> Optional["Beacon"]:
+        """The driver's hook: a beacon when `-heartbeat-dir` is set (the
+        supervisor hands every worker one), else None.  Rank comes from
+        the explicit -process-id, falling back to jax's own index for
+        auto-detected clusters (lazy import -- non-distributed runs never
+        touch jax here)."""
+        if not cfg.heartbeat_dir:
+            return None
+        rank = cfg.process_id
+        if rank < 0:
+            if cfg.distributed:
+                import jax
+
+                rank = jax.process_index()
+            else:
+                rank = 0
+        return cls(cfg.heartbeat_dir, rank)
+
+    def stamp(self, window: int) -> None:
+        doc = {"worker": self.rank, "window": int(window),
+               "pid": os.getpid(), "time": time.time()}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+
+
+class Monitor:
+    """The supervisor side: read every beacon, name the lost."""
+
+    def __init__(self, hb_dir: str, workers: int, timeout_ms: int):
+        self.hb_dir = hb_dir
+        self.workers = workers
+        self.timeout_ms = timeout_ms
+        # Window-lag equivalent of the wall-clock timeout: one poll window
+        # is WINDOW_MS simulated ms, so a timeout of K*WINDOW_MS ms maps
+        # to K windows of allowed lag (floor 1 -- a worker is never lost
+        # for being exactly one window behind the loop's own stamp).
+        self.lag_windows = max(1, timeout_ms // WINDOW_MS)
+
+    def read(self, rank: int) -> Optional[dict]:
+        try:
+            with open(beacon_path(self.hb_dir, rank)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def last_window(self, rank: int) -> int:
+        doc = self.read(rank)
+        return int(doc["window"]) if doc else -1
+
+    def lagging(self, current_window: int, live=None) -> Optional[int]:
+        """First live worker whose beacon window trails `current_window`
+        by more than lag_windows; None when everyone keeps up.  A worker
+        with no beacon yet only counts once the loop itself is past the
+        allowed lag (startup grace)."""
+        for rank in range(self.workers):
+            if live is not None and rank not in live:
+                continue
+            if current_window - self.last_window(rank) > self.lag_windows:
+                return rank
+        return None
+
+    def stale(self, now: Optional[float] = None, live=None) -> Optional[int]:
+        """First live worker whose beacon EXISTS but is wall-clock staler
+        than the timeout; None otherwise (a missing beacon is a worker
+        still starting up -- process exit, not staleness, covers a worker
+        that died before its first window)."""
+        now = time.time() if now is None else now
+        for rank in range(self.workers):
+            if live is not None and rank not in live:
+                continue
+            doc = self.read(rank)
+            if doc is None:
+                continue
+            if (now - float(doc["time"])) * 1000.0 > self.timeout_ms:
+                return rank
+        return None

@@ -1,0 +1,403 @@
+"""Host-loss supervision (ISSUE 20): detect, restore, reshard, resume.
+
+Two flavors share the same detection + recovery semantics:
+
+``run_supervised`` -- the single-process drillable loop.  The live mesh's
+devices are partitioned contiguously into ``-workers`` logical workers;
+each stamps a heartbeat beacon every poll window.  A ``-chaos
+kill-worker@W:K`` drill (or a beacon lagging past the heartbeat timeout,
+the ``stall-worker`` drill path) declares worker W lost at window K: as
+on a real pod, a lost host wedges every collective, so the WHOLE live
+state is torn down and the last atomic snapshot -- sha256-verified and
+provenance-checked (run_id + -recover-max-stale, utils/checkpoint.py) --
+is restored onto the survivor mesh through serve.py's checkpoint ->
+reshard -> restore sequence (build_stepper + load_state_pytree, whose
+reshard_mail_rings re-buckets the in-flight mail onto the narrower shard
+count).  The loop then REWINDS its window counter to the snapshot window
+and replays: the injection schedule and step keys are pure functions of
+(config, window, global id), so the replayed windows reproduce the
+pre-loss trajectory exactly and the run ends Stats-exact against an
+uninterrupted twin, with the replay accounted separately as
+``recovered_windows`` / ``recovery_pause_ms``.
+
+``run_supervisor`` -- the real process-spawning flavor: N CLI workers
+joined via the bounded ``jax.distributed`` initialize
+(parallel/mesh.py), monitored by process exit + wall-clock beacon
+staleness; on loss the surviving process set relaunches with ``-resume``
+against the shared checkpoint dir (num_processes - lost), after the same
+provenance gate.  Runs behind the capability probe in CI -- two-process
+CPU collectives are not universally supported.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import time
+import uuid
+from typing import Optional
+
+from gossip_simulator_tpu.config import Config, parse_chaos
+from gossip_simulator_tpu.distributed import heartbeat, worker as _worker
+from gossip_simulator_tpu.utils import lifecycle as _lifecycle
+from gossip_simulator_tpu.utils import trace as _trace
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter
+
+
+def fresh_run_id() -> str:
+    """The provenance token stamped into every snapshot sidecar this run
+    writes; recovery refuses to restore anyone else's (ISSUE 20 sat. 2)."""
+    return uuid.uuid4().hex[:12]
+
+
+def survivor_shard_count(n: int, s_old: int, survivor_devices: int) -> int:
+    """Largest shard count the survivors can host: <= their device count,
+    never wider than the lost mesh (recovery narrows, it does not
+    opportunistically widen), and dividing n (mesh.shard_size's
+    contract).  Floor 1 -- a single survivor still restores."""
+    s = max(min(s_old, survivor_devices), 1)
+    while s > 1 and n % s:
+        s -= 1
+    return s
+
+
+@dataclasses.dataclass
+class SupervisedOutcome:
+    """What the driver needs back from the supervised phase-2 loop --
+    the serve.ServeOutcome shape minus the autoscaler fields, plus the
+    host-loss report for result.json / the flight recorder."""
+
+    stepper: object
+    windows: int
+    converged: bool
+    interrupted: bool
+    rows: list
+    report: dict
+
+
+def _recover(cfg: Config, dead: int, cause: str, loss_window: int,
+             run_id: str, epoch: int, workers: int, lost: set,
+             s_old: int, printer: ProgressPrinter):
+    """Teardown -> provenance-checked restore -> survivor mesh.  Returns
+    (stepper, ckpt_window, record); raises with the snapshot named on a
+    missing/corrupt/foreign/stale checkpoint (never restores garbage)."""
+    import jax
+
+    from gossip_simulator_tpu import serve as _serve
+    from gossip_simulator_tpu.utils import checkpoint
+
+    t0 = time.perf_counter()
+    devices = len(jax.devices())
+    per = max(devices // workers, 1)
+    survivors = devices - len(lost | {dead}) * per
+    with _trace.span("hostloss.recover", cat="phase", worker=dead,
+                     cause=cause, window=loss_window) as sp:
+        path = checkpoint.latest(cfg.checkpoint_dir)
+        if path is None:
+            raise RuntimeError(
+                f"host loss at window {loss_window} (worker {dead}, "
+                f"{cause}) but no snapshot exists in {cfg.checkpoint_dir} "
+                f"yet (first save lands at window {cfg.checkpoint_every}); "
+                "nothing to recover from")
+        tree, meta = checkpoint.load(path)  # sha256-verified
+        checkpoint.verify_provenance(
+            meta, path=path, run_id=run_id, now_window=loss_window,
+            max_stale=cfg.recover_max_stale)
+        ckpt_window = int(meta.get("window", 0))
+        s_new = survivor_shard_count(cfg.n, s_old, survivors)
+        # serve.py's checkpoint -> reshard -> restore sequence: a fresh
+        # ready-to-restore stepper on the survivor mesh, then the wholesale
+        # state overwrite (reshard_mail_rings re-buckets in-flight mail
+        # when s_new != s_old).
+        stepper = _serve.build_stepper(cfg, s_new)
+        stepper.load_state_pytree(tree)
+        pause_ms = (time.perf_counter() - t0) * 1000.0
+        record = {"worker": dead, "cause": cause, "window": loss_window,
+                  "ckpt_window": ckpt_window,
+                  "recovered_windows": loss_window - ckpt_window,
+                  "pause_ms": round(pause_ms, 3),
+                  "from_shards": s_old, "to_shards": s_new,
+                  "epoch": epoch}
+        if sp is not None:
+            sp.update(record)
+    printer.note(
+        f"host loss: worker {dead} ({cause}) at window {loss_window}; "
+        f"restored {os.path.basename(path)} onto {s_new} survivor "
+        f"shard(s), replaying {record['recovered_windows']} window(s) "
+        f"(pause {pause_ms:.0f}ms)")
+    return stepper, ckpt_window, record
+
+
+def run_supervised(cfg: Config, stepper, printer: ProgressPrinter,
+                   max_windows: int, collect_rows: bool = False,
+                   run_id: str = "") -> SupervisedOutcome:
+    """The supervised phase-2 loop (driver dispatch under -supervise with
+    no -coordinator).  `stepper` arrives initialized and seeded, exactly
+    like serve.run_serve; the outcome's stepper is whichever incarnation
+    ran the final window."""
+    from gossip_simulator_tpu.utils import checkpoint
+
+    run_id = run_id or fresh_run_id()
+    drill = parse_chaos(cfg.chaos)
+    workers = cfg.workers
+    hb_dir = cfg.heartbeat_dir_resolved
+    beacons = [heartbeat.Beacon(hb_dir, i) for i in range(workers)]
+    monitor = heartbeat.Monitor(hb_dir, workers, cfg.heartbeat_timeout_ms)
+    target = cfg.coverage_target
+
+    rows: list = []
+    recoveries: list = []
+    lost: set = set()
+    stalled: set = set()
+    windows = 0
+    converged = False
+    interrupted = False
+    epoch = 0
+    drill_fired = False
+    stats = stepper.stats()
+
+    while windows < max_windows:
+        with _trace.span("supervise.window", cat="window") as sp:
+            stats = stepper.gossip_window()
+            if sp is not None:
+                sp.update(round=int(stats.round),
+                          received=int(stats.total_received))
+        windows += 1
+        if collect_rows:
+            rows.append((stats.round, stats.total_received,
+                         stats.total_message, stats.total_crashed,
+                         stats.total_removed))
+        printer.coverage_window(round(stats.coverage * 100.0, 4),
+                                stepper.sim_time_ms())
+        # Liveness beacons: every live logical worker stamps this window.
+        # A stall-worker drill silences its target's beacon from the drill
+        # window on, so detection exercises the REAL heartbeat-lag path.
+        if (drill is not None and drill.kind == "stall-worker"
+                and windows >= drill.window):
+            stalled.add(drill.worker)
+        for i, b in enumerate(beacons):
+            if i not in lost and i not in stalled:
+                b.stamp(windows)
+        # Checkpoint cadence (validate() guarantees it is on): every
+        # snapshot carries the provenance sidecar recovery will demand.
+        if windows % cfg.checkpoint_every == 0:
+            tree = stepper.state_pytree()
+            if tree is not None and stepper.primary_host:
+                checkpoint.save(cfg.checkpoint_dir, windows, tree, stats,
+                                extra_meta={"run_id": run_id,
+                                            "epoch": epoch})
+                checkpoint.prune(cfg.checkpoint_dir, cfg.ckpt_keep)
+        if stats.coverage >= target:
+            converged = True
+            break
+        if getattr(stepper, "exhausted", False):
+            break
+        if _lifecycle.shutdown_requested():
+            interrupted = True
+            break
+        # --- loss detection ----------------------------------------------
+        dead: Optional[int] = None
+        cause = ""
+        if (drill is not None and drill.kind == "kill-worker"
+                and not drill_fired and windows >= drill.window):
+            dead, cause, drill_fired = drill.worker, "drill", True
+        else:
+            lag = monitor.lagging(windows, live=set(range(workers)) - lost)
+            if lag is not None:
+                dead, cause = lag, "heartbeat"
+        if dead is not None:
+            from gossip_simulator_tpu import serve as _serve
+
+            lost.add(dead)
+            epoch += 1
+            stepper, ckpt_window, record = _recover(
+                cfg, dead, cause, windows, run_id, epoch, workers, lost,
+                _serve.shard_count(stepper), printer)
+            recoveries.append(record)
+            # Rewind to the snapshot and replay: the deterministic
+            # schedule reproduces the pre-loss windows exactly, so the
+            # trajectory rows (and the final Stats) match an
+            # uninterrupted twin -- the replayed span is accounted in
+            # recovered_windows, not hidden in the window count.
+            windows = ckpt_window
+            del rows[windows:]
+            stats = stepper.stats()
+
+    report = {
+        "workers": workers,
+        "lost": sorted(lost),
+        "recoveries": recoveries,
+        "recovered_windows": sum(r["recovered_windows"]
+                                 for r in recoveries),
+        "recovery_pause_ms": round(sum(r["pause_ms"] for r in recoveries),
+                                   3),
+        "heartbeat": {"timeout_ms": cfg.heartbeat_timeout_ms,
+                      "lag_windows": monitor.lag_windows,
+                      "dir": hb_dir},
+        "run_id": run_id,
+    }
+    return SupervisedOutcome(stepper=stepper, windows=windows,
+                             converged=converged, interrupted=interrupted,
+                             rows=rows, report=report)
+
+
+# --------------------------------------------------------------------------
+# Real process-spawning supervisor (multi-host flavor)
+# --------------------------------------------------------------------------
+
+def _read_sidecar(path: str) -> dict:
+    try:
+        with open(path + ".json") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _terminate_all(procs: dict, grace_s: float = 5.0) -> None:
+    """Teardown: a lost host wedges the collective everywhere, so every
+    remaining worker goes down before the survivors relaunch."""
+    for p in procs.values():
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + grace_s
+    for p in procs.values():
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+
+
+def run_supervisor(cfg: Config, argv: Optional[list[str]] = None) -> int:
+    """Spawn -workers CLI worker processes joined via jax.distributed,
+    monitor them (exit codes + wall-clock beacon staleness + the
+    kill-worker drill), and on host loss relaunch the survivors with
+    -resume on a narrower process set.  Returns the final incarnation's
+    exit code; writes a supervisor.json report into -run-dir when set."""
+    import sys
+
+    from gossip_simulator_tpu.utils import checkpoint
+
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    run_id = cfg.run_id or fresh_run_id()
+    hb_dir = cfg.heartbeat_dir_resolved
+    os.makedirs(hb_dir, exist_ok=True)
+    host, port_s = cfg.coordinator.rsplit(":", 1)
+    base_port = int(port_s)
+    num = cfg.workers
+    drill = parse_chaos(cfg.chaos)
+    drill_fired = False
+    epoch = 0
+    recoveries: list = []
+    _lifecycle.install_signal_handlers()
+
+    def _spawn(num_procs: int, resume: bool) -> dict:
+        # Fresh beacon slate per incarnation: a leftover beacon from the
+        # previous (or a crashed earlier) run carries a stale wall clock,
+        # and a relaunch that recompiles for longer than the heartbeat
+        # timeout must not read as a second host loss -- a MISSING beacon
+        # is "still starting", never stale.
+        for rank in range(cfg.workers):
+            try:
+                os.remove(heartbeat.beacon_path(hb_dir, rank))
+            except OSError:
+                pass
+        # Each incarnation gets its own coordinator port: the previous
+        # coordination service died with rank 0 and its port may linger
+        # in TIME_WAIT.
+        coord = f"{host}:{base_port + epoch}"
+        procs = {}
+        for rank in range(num_procs):
+            cmd = _worker.worker_cmd(argv, rank=rank,
+                                     num_processes=num_procs,
+                                     coordinator=coord,
+                                     heartbeat_dir=hb_dir, run_id=run_id,
+                                     resume=resume)
+            procs[rank] = subprocess.Popen(cmd, env=dict(os.environ))
+        return procs
+
+    procs = _spawn(num, resume=False)
+    monitor = heartbeat.Monitor(hb_dir, num, cfg.heartbeat_timeout_ms)
+    _lifecycle.register_on_shutdown(lambda: _terminate_all(procs))
+    rc = 2
+    while True:
+        time.sleep(0.2)
+        if _lifecycle.shutdown_requested():
+            _terminate_all(procs)
+            rc = 2
+            break
+        # Injected drill: SIGKILL the target once its beacon shows it past
+        # the drill window (so the kill interrupts REAL mid-run progress,
+        # after at least one checkpoint-capable window).
+        if (drill is not None and drill.kind == "kill-worker"
+                and not drill_fired and drill.worker in procs
+                and monitor.last_window(drill.worker) >= drill.window):
+            procs[drill.worker].kill()
+            drill_fired = True
+        codes = {r: p.poll() for r, p in procs.items()}
+        if all(c == 0 for c in codes.values()):
+            rc = 0
+            break
+        dead = [r for r, c in codes.items() if c not in (None, 0)]
+        if not dead:
+            s = monitor.stale(live=set(procs))
+            if s is not None:
+                dead = [s]
+            elif all(c is not None for c in codes.values()):
+                # Everyone exited, someone nonzero-but-not-killed: the
+                # run itself failed (e.g. not converged) -- propagate.
+                rc = max(c for c in codes.values())
+                break
+        if dead:
+            t0 = time.perf_counter()
+            loss_window = max((monitor.last_window(r) for r in procs),
+                              default=0)
+            _terminate_all(procs)
+            num -= len(dead)
+            if num < 1:
+                print("supervisor: no survivors left; giving up",
+                      file=sys.stderr)
+                rc = 2
+                break
+            path = checkpoint.latest(cfg.checkpoint_dir)
+            if path is None:
+                print("supervisor: host loss before the first snapshot; "
+                      f"nothing to recover from in {cfg.checkpoint_dir}",
+                      file=sys.stderr)
+                rc = 2
+                break
+            # Provenance + staleness gate BEFORE burning a relaunch: the
+            # sidecar alone decides (no array load on the supervisor).
+            checkpoint.verify_provenance(
+                _read_sidecar(path), path=path, run_id=run_id,
+                now_window=loss_window, max_stale=cfg.recover_max_stale)
+            epoch += 1
+            ckpt_window = int(_read_sidecar(path).get("window", 0))
+            recoveries.append({
+                "workers_lost": sorted(dead), "window": loss_window,
+                "ckpt_window": ckpt_window,
+                "recovered_windows": loss_window - ckpt_window,
+                "epoch": epoch, "num_processes": num,
+                "pause_ms": round((time.perf_counter() - t0) * 1000.0, 3)})
+            print(f"supervisor: lost worker(s) {sorted(dead)} at window "
+                  f"~{loss_window}; relaunching {num} survivor(s) with "
+                  f"-resume from {os.path.basename(path)}",
+                  file=sys.stderr)
+            procs = _spawn(num, resume=True)
+            monitor = heartbeat.Monitor(hb_dir, num,
+                                        cfg.heartbeat_timeout_ms)
+    report = {"run_id": run_id, "workers": cfg.workers,
+              "final_processes": num, "exit_code": rc,
+              "recoveries": recoveries,
+              "recovered_windows": sum(r["recovered_windows"]
+                                       for r in recoveries),
+              "recovery_pause_ms": round(sum(r["pause_ms"]
+                                             for r in recoveries), 3)}
+    if cfg.run_dir:
+        os.makedirs(cfg.run_dir, exist_ok=True)
+        with open(os.path.join(cfg.run_dir, "supervisor.json"), "w") as f:
+            json.dump(report, f, indent=1)
+    print("supervisor: " + json.dumps(report), file=sys.stderr)
+    return rc

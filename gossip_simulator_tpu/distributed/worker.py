@@ -1,0 +1,65 @@
+"""Worker-side plumbing for the real multi-process supervisor (ISSUE 20).
+
+A worker is not a new entry point: it is the ordinary CLI run with
+``-distributed`` plus the supervisor's wiring flags (-coordinator /
+-num-processes / -process-id / -heartbeat-dir / -run-id).  This module is
+the argv surgery that builds each worker's command line from the
+supervisor's OWN command line -- strip the supervisor-only flags, append
+the worker wiring -- so the simulation flags (n, graph, seed, engine,
+checkpoint cadence, ...) reach every worker verbatim and the relaunched
+survivors resume the same run by construction.
+"""
+
+from __future__ import annotations
+
+import sys
+
+# Supervisor-only flags that must never reach a worker: the boolean
+# switch, then every valued flag (single- and double-dash spellings both
+# parse, and argparse also accepts --flag=value).
+_STRIP_BOOL = {"-supervise", "--supervise", "-resume", "--resume"}
+_STRIP_VALUED = {"-workers", "--workers", "-chaos", "--chaos",
+                 "-coordinator", "--coordinator",
+                 "-heartbeat-dir", "--heartbeat-dir",
+                 "-heartbeat-timeout-ms", "--heartbeat-timeout-ms",
+                 "-recover-max-stale", "--recover-max-stale",
+                 "-run-id", "--run-id",
+                 "-num-processes", "--num-processes",
+                 "-process-id", "--process-id"}
+
+
+def strip_supervisor_flags(argv: list[str]) -> list[str]:
+    """The simulation flags only: supervisor argv minus everything the
+    supervisor owns (wiring flags are re-appended per worker)."""
+    out: list[str] = []
+    skip = False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok in _STRIP_BOOL:
+            continue
+        if tok in _STRIP_VALUED:
+            skip = True
+            continue
+        if "=" in tok and tok.split("=", 1)[0] in (_STRIP_BOOL
+                                                   | _STRIP_VALUED):
+            continue
+        out.append(tok)
+    return out
+
+
+def worker_cmd(argv: list[str], *, rank: int, num_processes: int,
+               coordinator: str, heartbeat_dir: str, run_id: str,
+               resume: bool = False) -> list[str]:
+    """One worker's full command line.  `resume` is the relaunch flavor:
+    the survivors restart on the narrower process set and continue from
+    the latest shared snapshot (the checkpoint dir rode through argv)."""
+    cmd = [sys.executable, "-m", "gossip_simulator_tpu",
+           *strip_supervisor_flags(argv),
+           "-distributed", "-coordinator", coordinator,
+           "-num-processes", str(num_processes), "-process-id", str(rank),
+           "-heartbeat-dir", heartbeat_dir, "-run-id", run_id]
+    if resume:
+        cmd.append("-resume")
+    return cmd

@@ -12,6 +12,7 @@ import contextlib
 import dataclasses
 import os
 import time
+import uuid
 from typing import Optional
 
 from gossip_simulator_tpu import tuning as _tuning
@@ -32,6 +33,11 @@ class RunResult:
     converged: bool
     overlay_windows: int
     gossip_windows: int
+    # Host-loss supervision (ISSUE 20): windows replayed after restoring
+    # from the last snapshot, and wall-clock paused during recovery.
+    # Zero / 0.0 unless -supervise recovered from a loss.
+    recovered_windows: int = 0
+    recovery_pause_ms: float = 0.0
 
 
 def run_simulation(cfg: Config, printer: Optional[ProgressPrinter] = None,
@@ -137,6 +143,22 @@ def _run(cfg: Config, printer: ProgressPrinter,
     if telem is not None:
         telem.add_phase("init_s", time.perf_counter() - t_init)
 
+    # Checkpoint provenance (ISSUE 20 satellite 2): the explicit -run-id,
+    # else a generated token under supervision / worker mode.  Stamped into
+    # every snapshot sidecar this run writes; empty for plain runs so their
+    # sidecars stay byte-identical to pre-provenance builds.
+    run_id = cfg.run_id
+    if not run_id and (cfg.supervise or cfg.heartbeat_dir):
+        run_id = uuid.uuid4().hex[:12]
+    # Liveness beacon (distributed/heartbeat.py): a supervised worker
+    # stamps its rank's beacon once per poll window in BOTH phases, so the
+    # supervisor's staleness monitor sees progress, not just existence.
+    beacon = None
+    if cfg.heartbeat_dir and not cfg.supervise:
+        from gossip_simulator_tpu.distributed import heartbeat as _heartbeat
+
+        beacon = _heartbeat.Beacon.for_cfg(cfg)
+
     # --- Resume: from a phase-2 snapshot (skip straight into phase 2) or a
     # phase-1 overlay snapshot (continue construction mid-overlay) -------------
     resumed = False
@@ -158,6 +180,13 @@ def _run(cfg: Config, printer: ProgressPrinter,
                    "checkpoint dir; put it on a shared filesystem)"
                    if cfg.distributed else ""))
         tree, meta = checkpoint.load(path)
+        # Provenance gate on an explicit -run-id: a relaunched survivor
+        # (same -run-id as the original incarnation) passes; a snapshot
+        # from some OTHER run is refused by name.  Staleness is the
+        # supervisor's call (it knows the loss window), not resume's.
+        if run_id:
+            checkpoint.verify_provenance(meta, path=path, run_id=run_id,
+                                         now_window=0, max_stale=0)
         # Phase detection falls back to tree contents (win_makeups exists
         # only on overlay state) so a snapshot whose .json sidecar was
         # lost in a copy still routes to the right restore path.
@@ -199,7 +228,7 @@ def _run(cfg: Config, printer: ProgressPrinter,
                          "at this n; -overlay-mode ticks gives per-message-"
                          "faithful timing at ~2x the cost")
         max_overlay_windows = max(cfg.max_rounds, 1000)
-        ckpt1 = _Checkpointer(cfg, stepper)
+        ckpt1 = _Checkpointer(cfg, stepper, run_id=run_id)
         # Same observability gate as the phase-2 fast path below: a quiet
         # run has no per-window output, so stabilization can run as bounded
         # device-side while_loops (one host sync per watchdog-bounded call
@@ -243,6 +272,8 @@ def _run(cfg: Config, printer: ProgressPrinter,
                 # (simulator.go:227-230).
                 printer.overlay_window(breakups, makeups,
                                        stepper.sim_time_ms())
+                if beacon is not None:
+                    beacon.stamp(overlay_windows)
                 ckpt1.maybe_save_overlay(overlay_windows)
                 if _lifecycle.shutdown_requested():
                     p1_interrupted = True
@@ -271,7 +302,7 @@ def _run(cfg: Config, printer: ProgressPrinter,
     max_windows = max(0, -(-(cfg.max_rounds - elapsed) // window_rounds))
     gossip_windows = 0
     converged = False
-    ckpt = _Checkpointer(cfg, stepper)
+    ckpt = _Checkpointer(cfg, stepper, run_id=run_id)
     # Nothing on a quiet, uncheckpointed, unlogged run observes per-window
     # state, so the whole epidemic runs as bounded device-side while_loops
     # with a handful of host syncs total -- the windowed loop below pays a
@@ -296,10 +327,29 @@ def _run(cfg: Config, printer: ProgressPrinter,
     # the final stats come from both ride the ServeOutcome.
     live_cfg = cfg
     serve_report = None
+    hostloss_report = None
     interrupted = p1_interrupted
     with _maybe_profile(cfg):
         if p1_interrupted:
             pass
+        elif cfg.supervise:
+            from gossip_simulator_tpu.distributed import supervisor as _sup
+
+            outcome = _sup.run_supervised(cfg, stepper, printer,
+                                          max_windows,
+                                          collect_rows=collect_rows,
+                                          run_id=run_id)
+            stepper = outcome.stepper
+            gossip_windows = outcome.windows
+            converged = outcome.converged
+            window_rows = outcome.rows
+            hostloss_report = outcome.report
+            interrupted = interrupted or outcome.interrupted
+            # A recovery rebuilds the stepper; device-recorded telemetry
+            # histories do not survive that (same rule as serve's
+            # reshards), so the artifact trajectory uses the
+            # host-collected rows.
+            telem = None
         elif cfg.serve:
             from gossip_simulator_tpu import serve as _serve
 
@@ -351,6 +401,8 @@ def _run(cfg: Config, printer: ProgressPrinter,
                                         stats.total_removed))
                 pct = stats.coverage * 100.0
                 printer.coverage_window(round(pct, 4), stepper.sim_time_ms())
+                if beacon is not None:
+                    beacon.stamp(resume_window + gossip_windows)
                 # Offset by the restored window so post-resume snapshot
                 # numbers continue the sequence (checkpoint.latest is
                 # lexicographic).
@@ -413,6 +465,16 @@ def _run(cfg: Config, printer: ProgressPrinter,
         payload["serve"] = {k: serve_report[k] for k in
                             ("arrivals", "final_shards", "resizes",
                              "reshard_pause_ms", "shed")}
+    if hostloss_report is not None:
+        # Replayed-window accounting (ISSUE 20): how many windows the
+        # recovery re-ran from the snapshot and what the restore pause
+        # cost -- top-level for compare_runs-adjacent tooling, full
+        # detail under "hostloss".
+        result.recovered_windows = hostloss_report["recovered_windows"]
+        result.recovery_pause_ms = hostloss_report["recovery_pause_ms"]
+        payload["recovered_windows"] = hostloss_report["recovered_windows"]
+        payload["recovery_pause_ms"] = hostloss_report["recovery_pause_ms"]
+        payload["hostloss"] = hostloss_report
     if cfg.multi_rumor and not p1_interrupted:
         # live_cfg, not cfg: admission deferrals rewrite the injection
         # schedule, and latency is measured against what actually ran.
@@ -437,7 +499,7 @@ def _run(cfg: Config, printer: ProgressPrinter,
             printer.block(report.summary_block())
     if cfg.run_dir and not printer.silent:
         _write_run_dir(cfg, telem, window_rows, payload, stats,
-                       serve_report)
+                       serve_report, hostloss_report)
     return result
 
 
@@ -468,7 +530,8 @@ def _final_shutdown_checkpoint(cfg: Config, stepper: Stepper, stats: Stats,
 
 
 def _write_run_dir(cfg: Config, telem, window_rows: list, payload: dict,
-                   stats: Stats, serve_report: Optional[dict] = None) -> None:
+                   stats: Stats, serve_report: Optional[dict] = None,
+                   hostloss_report: Optional[dict] = None) -> None:
     """Flush the `-run-dir` artifact (utils/artifact.py layout).  The
     trajectory prefers the device-recorded history (fast path), falls
     back to the windowed loop's host-collected rows, and degrades to a
@@ -503,6 +566,8 @@ def _write_run_dir(cfg: Config, telem, window_rows: list, payload: dict,
             hist_g, cap=_health.ring_slot_cap(cfg, n_shards))))
     if serve_report is not None:
         rdir.write_serve(serve_report)
+    if hostloss_report is not None:
+        rdir.write_hostloss(hostloss_report)
     rdir.write_result({
         **payload,
         "fingerprint": artifact.fingerprint_rows(traj),
@@ -563,8 +628,11 @@ def _multi_rumor_report(cfg: Config, stepper: Stepper, stats: Stats,
 
 
 class _Checkpointer:
-    def __init__(self, cfg: Config, stepper: Stepper):
+    def __init__(self, cfg: Config, stepper: Stepper, run_id: str = ""):
         self.cfg, self.stepper = cfg, stepper
+        # Provenance sidecar keys (empty run_id = none, keeping plain
+        # runs' sidecars byte-identical to pre-provenance builds).
+        self.extra_meta = {"run_id": run_id} if run_id else None
 
     def _due(self, window: int) -> bool:
         cfg = self.cfg
@@ -580,7 +648,8 @@ class _Checkpointer:
         # only the primary host writes the file.
         tree = self.stepper.state_pytree()
         if tree is not None and self.stepper.primary_host:
-            checkpoint.save(self.cfg.checkpoint_dir, window, tree, stats)
+            checkpoint.save(self.cfg.checkpoint_dir, window, tree, stats,
+                            extra_meta=self.extra_meta)
             checkpoint.prune(self.cfg.checkpoint_dir, self.cfg.ckpt_keep)
 
     def maybe_save_overlay(self, window: int) -> None:

@@ -9,11 +9,68 @@ through the same axis via jax's standard multi-host initialization.
 
 from __future__ import annotations
 
+import inspect
+import time
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 AXIS = "nodes"
+
+
+class DistributedInitError(RuntimeError):
+    """jax.distributed.initialize failed after bounded, retried attempts.
+    Carries the coordinator address, attempt count and elapsed seconds in
+    the message -- a *named* failure instead of a silent hang (the r6-r9
+    TPU pool attempts each burned a full opaque 60s timeout)."""
+
+
+def bounded_initialize(coordinator_address=None, num_processes=None,
+                       process_id=None, timeout_s: float = 60.0,
+                       retries: int = 3, base_delay_s: float = 1.0,
+                       _sleep=time.sleep) -> float:
+    """`jax.distributed.initialize` with a bounded per-attempt timeout and
+    exponential-backoff retry.  Passes jax's own `initialization_timeout`
+    when this jax version accepts it (0.4.15+); on older jax the attempt
+    relies on jax's internal default but the retry/naming contract still
+    holds.  Returns elapsed seconds on success; raises DistributedInitError
+    naming address, attempts and elapsed on exhaustion.  None kwargs are
+    omitted so jax's env autodetection still applies."""
+    kw = {}
+    if coordinator_address is not None:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    try:
+        params = inspect.signature(jax.distributed.initialize).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        params = {}
+    if "initialization_timeout" in params:
+        kw["initialization_timeout"] = max(int(timeout_s), 1)
+    t0 = time.monotonic()
+    last_err: Exception | None = None
+    attempts = max(retries, 1)
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(**kw)
+            return time.monotonic() - t0
+        except Exception as e:  # noqa: BLE001 - grpc raises bare RuntimeError
+            last_err = e
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 - nothing to tear down
+                pass
+            if attempt < attempts - 1:
+                _sleep(base_delay_s * (2 ** attempt))
+    elapsed = time.monotonic() - t0
+    addr = coordinator_address or "<env-autodetected>"
+    raise DistributedInitError(
+        f"jax.distributed.initialize failed against {addr} after "
+        f"{attempts} attempt(s) in {elapsed:.1f}s "
+        f"(timeout {timeout_s:.0f}s/attempt): {last_err}") from last_err
 
 
 def shard_map(fn, mesh, in_specs, out_specs):

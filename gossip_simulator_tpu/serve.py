@@ -221,6 +221,15 @@ def run_serve(cfg: Config, stepper: Stepper, printer: ProgressPrinter,
     force = parse_serve_force(cfg.serve_force)
     target = cfg.coverage_target
 
+    # Liveness beacon (distributed/heartbeat.py): a serving worker under a
+    # supervisor stamps its rank once per window, same as the windowed
+    # driver loops -- progress, not just process existence.
+    beacon = None
+    if cfg.heartbeat_dir:
+        from gossip_simulator_tpu.distributed import heartbeat as _heartbeat
+
+        beacon = _heartbeat.Beacon.for_cfg(cfg)
+
     rows: list = []
     decisions: list = []
     segments: list = []
@@ -259,6 +268,8 @@ def run_serve(cfg: Config, stepper: Stepper, printer: ProgressPrinter,
                          stats.total_removed))
         printer.coverage_window(round(stats.coverage * 100.0, 4),
                                 stepper.sim_time_ms())
+        if beacon is not None:
+            beacon.stamp(resume_window + windows)
         if (live_cfg.checkpointing_enabled
                 and windows % live_cfg.checkpoint_every == 0):
             tree = stepper.state_pytree()

@@ -204,6 +204,12 @@ class RunDir:
         pause spans and SLO summary (gossip_simulator_tpu/serve.py)."""
         return self._write_json("serve.json", doc)
 
+    def write_hostloss(self, doc: dict) -> str:
+        """Host-loss supervisor sidecar (distributed/supervisor.py): the
+        per-recovery records (cause, snapshot, replayed windows, pause)
+        plus detection settings."""
+        return self._write_json("hostloss.json", doc)
+
     def write_health(self, verdict: dict) -> str:
         """Shard-health watchdog verdict (utils/health.py) over the
         spatial panels: status + the findings that fired."""

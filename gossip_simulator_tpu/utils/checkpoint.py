@@ -143,6 +143,35 @@ def load(path: str) -> tuple[dict[str, np.ndarray], dict]:
         return arrays, meta
 
 
+def verify_provenance(meta: dict, path: str, *, run_id: str,
+                      now_window: int, max_stale: int = 0) -> None:
+    """The host-loss recovery gate (ISSUE 20 satellite 2): refuse BY NAME
+    to restore a snapshot from a different run or one staler than
+    ``-recover-max-stale`` windows behind the loss point.  A survivor that
+    silently resurrects a foreign or ancient snapshot would "recover" into
+    a different simulation; both refusals are ValueError so the drill
+    tests can pin the message.
+
+    `run_id` empty means this run makes no provenance claim (plain
+    -resume); pre-provenance snapshots (no run_id in the sidecar) pass the
+    run check for backward compatibility but still face the staleness
+    gate.  `max_stale <= 0` disables the staleness gate."""
+    theirs = meta.get("run_id")
+    if run_id and theirs is not None and theirs != run_id:
+        raise ValueError(
+            f"checkpoint {path} was written by run {theirs} but this "
+            f"supervisor run is {run_id}; refusing to restore a foreign "
+            "snapshot (pass its -run-id explicitly to adopt it)")
+    if max_stale > 0:
+        behind = now_window - int(meta.get("window", 0))
+        if behind > max_stale:
+            raise ValueError(
+                f"checkpoint {path} is {behind} window(s) behind the loss "
+                f"point (window {now_window}), over the -recover-max-stale "
+                f"limit of {max_stale}; refusing to resurrect stale state "
+                "-- lower -checkpoint-every or raise -recover-max-stale")
+
+
 def prepare_restore_tree(tree: dict, cfg, n_shards: int) -> dict:
     """Shared snapshot validation + coercion for the jax and sharded
     backends' ``load_state_pytree``: engine gate, n check, legacy-field

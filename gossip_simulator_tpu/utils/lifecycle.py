@@ -24,10 +24,31 @@ import signal
 
 _shutdown_signum: int | None = None
 _installed = False
+# Shutdown callbacks (ISSUE 20): fired once, from the first signal, before
+# the cooperative flag is even polled -- the real supervisor registers its
+# worker teardown here so a TERM'd supervisor does not strand N child
+# processes behind its own window-boundary polling.
+_on_shutdown: list = []
 
 
 def shutdown_requested() -> bool:
     return _shutdown_signum is not None
+
+
+def register_on_shutdown(cb) -> None:
+    """Run `cb()` when shutdown is first requested (signal or
+    programmatic).  Callbacks must be quick and exception-safe in spirit;
+    anything they raise is swallowed (a failing callback must not break
+    signal delivery).  Cleared by reset()."""
+    _on_shutdown.append(cb)
+
+
+def _fire_callbacks() -> None:
+    for cb in list(_on_shutdown):
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 - see register_on_shutdown
+            pass
 
 
 def shutdown_signal() -> int | None:
@@ -37,13 +58,18 @@ def shutdown_signal() -> int | None:
 def request_shutdown(signum: int = signal.SIGTERM) -> None:
     """Raise the flag programmatically (tests, embedding hosts)."""
     global _shutdown_signum
+    first = _shutdown_signum is None
     _shutdown_signum = signum
+    if first:
+        _fire_callbacks()
 
 
 def reset() -> None:
-    """Clear the flag (tests; a new run in the same process)."""
+    """Clear the flag and callbacks (tests; a new run in the same
+    process)."""
     global _shutdown_signum
     _shutdown_signum = None
+    _on_shutdown.clear()
 
 
 def _handler(signum, frame):
@@ -54,6 +80,7 @@ def _handler(signum, frame):
         signal.raise_signal(signum)
         return
     _shutdown_signum = signum
+    _fire_callbacks()
 
 
 def install_signal_handlers() -> bool:

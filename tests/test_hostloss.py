@@ -1,0 +1,456 @@
+"""Host-loss supervision (-supervise, ISSUE 20).
+
+Six surfaces:
+* Config/parse: -chaos drill specs, supervision validation rejections,
+  survivor_shard_count's never-widen contract.
+* The headline twins: a single-process supervised run that loses a worker
+  mid-epidemic (kill drill AND the heartbeat-lag stall drill) restores the
+  last snapshot onto the survivor mesh and ends Stats-exact vs an
+  uninterrupted twin -- on all four backend x engine combos, with
+  compare_runs exit 0 and the replayed windows accounted in
+  recovered_windows / recovery_pause_ms.
+* Supervisor-off pin: the new config fields default inert -- a plain run's
+  snapshot sidecars carry no provenance keys.
+* Provenance guard (utils/checkpoint.verify_provenance): foreign-run,
+  stale and corrupted snapshots are refused BY NAME, never restored.
+* Scenario interop: losing a host mid-churn with -overlay-heal on still
+  reaches the coverage target with repairs counted.
+* The bounded jax.distributed.initialize wrapper (parallel/mesh.py):
+  named DistributedInitError after retried, backoff'd attempts; plus the
+  real two-process SIGKILL drill behind the capability probe.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_simulator_tpu.config import Config, parse_chaos
+from gossip_simulator_tpu.distributed import heartbeat
+from gossip_simulator_tpu.distributed.supervisor import survivor_shard_count
+from gossip_simulator_tpu.distributed.worker import (strip_supervisor_flags,
+                                                     worker_cmd)
+from gossip_simulator_tpu.driver import run_simulation
+from gossip_simulator_tpu.parallel.mesh import (DistributedInitError,
+                                                bounded_initialize)
+from gossip_simulator_tpu.utils import checkpoint
+from gossip_simulator_tpu.utils.metrics import ProgressPrinter, Stats
+
+from test_distributed import _free_port, needs_multiprocess
+
+# Same rationale as tests/test_serve.py: the legacy shard_map line's CPU
+# collective rendezvous deadlocks when two different sharded executables
+# interleave in one process -- which every recovery restore does.
+legacy_shard_map_deadlock = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="legacy shard_map: CPU collective rendezvous deadlocks when two "
+           "sharded executables interleave in one process")
+
+# Stats-exactness recipe (test_serve.py): no randomized legacy faults and
+# a single-value delay draw make the trajectory shard-count invariant, so
+# a recovered run must match its uninterrupted twin bit-for-bit.
+BASE = dict(n=2048, graph="kout", fanout=6, seed=3, crashrate=0.0,
+            droprate=0.0, delaylow=10, delayhigh=11, protocol="si",
+            engine="event", backend="jax", rumors=8, traffic="stream",
+            stream_rate=40, coverage_target=0.99, progress=False)
+
+# Ring-engine flavor: stream traffic requires the event engine, so the
+# ring combos run the classic single-rumor oneshot broadcast.
+BASE_RING = dict(n=2048, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                 droprate=0.0, delaylow=10, delayhigh=11, protocol="si",
+                 engine="ring", backend="jax", coverage_target=0.99,
+                 progress=False)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _quiet():
+    return ProgressPrinter(enabled=False)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _supervised(base, tmp_path, **kw):
+    kw.setdefault("checkpoint_every", 2)
+    kw.setdefault("checkpoint_dir", str(tmp_path / "ckpt"))
+    return Config(**base, supervise=True, workers=2, **kw).validate()
+
+
+# --------------------------------------------------------------------------
+# Config surface
+# --------------------------------------------------------------------------
+
+def test_parse_chaos():
+    assert parse_chaos("") is None
+    d = parse_chaos("kill-worker@1:6")
+    assert (d.kind, d.worker, d.window) == ("kill-worker", 1, 6)
+    assert parse_chaos("stall-worker@0").window == 6  # default window
+    for bad in ("kill-worker", "reboot-worker@1", "kill-worker@x",
+                "kill-worker@1:0", "kill-worker@-1:3"):
+        with pytest.raises(ValueError, match="-chaos"):
+            parse_chaos(bad)
+
+
+def test_supervise_validation_rejections(tmp_path):
+    ck = dict(checkpoint_every=2, checkpoint_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="requires -supervise"):
+        Config(n=512, chaos="kill-worker@1", progress=False).validate()
+    with pytest.raises(ValueError, match="checkpoint"):
+        Config(n=512, supervise=True, progress=False).validate()
+    with pytest.raises(ValueError, match="workers"):
+        Config(n=512, supervise=True, workers=1, progress=False,
+               **ck).validate()
+    with pytest.raises(ValueError, match="exclusive"):
+        Config(**BASE, supervise=True, serve=True, **ck).validate()
+    with pytest.raises(ValueError, match="launches the -distributed"):
+        Config(n=512, supervise=True, distributed=True,
+               backend="sharded", progress=False, **ck).validate()
+    with pytest.raises(ValueError, match="resume"):
+        Config(n=512, supervise=True, resume=True, progress=False,
+               **ck).validate()
+    with pytest.raises(ValueError, match="backend sharded"):
+        Config(n=512, supervise=True, coordinator="localhost:9",
+               backend="jax", progress=False, **ck).validate()
+    with pytest.raises(ValueError, match="targets worker"):
+        Config(n=512, supervise=True, chaos="kill-worker@7",
+               progress=False, **ck).validate()
+
+
+def test_survivor_shard_count_never_widens():
+    # 8 devices, 2 workers: losing one leaves 4 -- narrow S=8 to 4.
+    assert survivor_shard_count(2048, 8, 4) == 4
+    # A jax (S=1) run stays S=1 however many devices survive.
+    assert survivor_shard_count(2048, 1, 4) == 1
+    # Divisibility: n=1000 on 3 survivor devices -> largest divisor <= 3.
+    assert survivor_shard_count(1000, 8, 3) == 2
+    # Floor: even zero surviving devices restores on one.
+    assert survivor_shard_count(2048, 8, 0) == 1
+
+
+def test_worker_argv_surgery():
+    argv = ["-n", "2048", "-supervise", "-workers", "2",
+            "-chaos", "kill-worker@1:6", "-checkpoint-every", "2",
+            "-checkpoint-dir", "/tmp/ck", "-recover-max-stale=3",
+            "-backend", "sharded"]
+    stripped = strip_supervisor_flags(argv)
+    assert stripped == ["-n", "2048", "-checkpoint-every", "2",
+                        "-checkpoint-dir", "/tmp/ck",
+                        "-backend", "sharded"]
+    cmd = worker_cmd(argv, rank=1, num_processes=2,
+                     coordinator="localhost:9", heartbeat_dir="/tmp/hb",
+                     run_id="abc", resume=True)
+    assert cmd[:3] == [sys.executable, "-m", "gossip_simulator_tpu"]
+    for flag, val in (("-process-id", "1"), ("-num-processes", "2"),
+                      ("-coordinator", "localhost:9"),
+                      ("-heartbeat-dir", "/tmp/hb"), ("-run-id", "abc")):
+        assert cmd[cmd.index(flag) + 1] == val
+    assert "-supervise" not in cmd and "-chaos" not in cmd
+    assert cmd[-1] == "-resume"
+
+
+# --------------------------------------------------------------------------
+# Heartbeat beacons
+# --------------------------------------------------------------------------
+
+def test_beacon_and_monitor(tmp_path):
+    hb = str(tmp_path)
+    mon = heartbeat.Monitor(hb, workers=2, timeout_ms=20)  # 2-window lag
+    assert mon.lag_windows == 2
+    b0, b1 = heartbeat.Beacon(hb, 0), heartbeat.Beacon(hb, 1)
+    b0.stamp(5)
+    b1.stamp(5)
+    assert mon.last_window(0) == 5
+    assert mon.lagging(6) is None  # one behind: within lag
+    b0.stamp(8)
+    assert mon.lagging(8) == 1  # worker 1 stuck at 5, 3 > 2
+    assert mon.lagging(8, live={0}) is None  # lost workers excluded
+    # Wall-clock staleness: a missing beacon is NOT stale...
+    os.remove(heartbeat.beacon_path(hb, 1))
+    assert mon.stale(now=time.time() + 100.0) == 0
+    os.remove(heartbeat.beacon_path(hb, 0))
+    assert mon.stale(now=time.time() + 100.0) is None
+    # ...and unreadable beacons read as missing, not a crash.
+    with open(heartbeat.beacon_path(hb, 0), "w") as f:
+        f.write("{torn")
+    assert mon.read(0) is None
+
+
+# --------------------------------------------------------------------------
+# The headline twins: loss -> restore -> Stats-exact, all four combos
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("base", [
+    pytest.param(BASE, id="jax-event"),
+    pytest.param(BASE_RING, id="jax-ring"),
+    pytest.param({**BASE, "backend": "sharded"}, id="sharded-event",
+                 marks=legacy_shard_map_deadlock),
+    pytest.param({**BASE_RING, "backend": "sharded"}, id="sharded-ring",
+                 marks=legacy_shard_map_deadlock),
+])
+def test_kill_drill_stats_exact_vs_twin(base, tmp_path):
+    da, db = str(tmp_path / "drill"), str(tmp_path / "twin")
+    cfg_a = _supervised(base, tmp_path, chaos="kill-worker@1:3",
+                        run_dir=da)
+    cfg_b = Config(**base, run_dir=db).validate()
+    ra = run_simulation(cfg_a, printer=_quiet())
+    rb = run_simulation(cfg_b, printer=_quiet())
+    assert ra.converged and rb.converged
+    assert ra.stats.to_dict() == rb.stats.to_dict()
+    assert ra.gossip_windows == rb.gossip_windows
+    res = json.load(open(os.path.join(da, "result.json")))
+    assert res["recovered_windows"] > 0
+    assert res["recovery_pause_ms"] > 0
+    assert res["shed"] == 0
+    doc = json.load(open(os.path.join(da, "hostloss.json")))
+    assert doc["lost"] == [1]
+    assert [r["cause"] for r in doc["recoveries"]] == ["drill"]
+    assert doc["recoveries"][0]["to_shards"] <= doc["recoveries"][0][
+        "from_shards"]
+    # compare_runs is the acceptance gate: trajectory-identical, exit 0.
+    assert _load_script("compare_runs").main([da, db]) == 0
+
+
+def test_stall_drill_detected_by_heartbeat_lag(tmp_path):
+    """The stall drill silences the target's beacon instead of killing it,
+    so the loss verdict comes from Monitor.lagging -- the REAL detection
+    path, deterministic (window-lag, not wall-clock) so the trajectory
+    stays pinned."""
+    da = str(tmp_path / "drill")
+    cfg_a = _supervised(BASE, tmp_path, chaos="stall-worker@1:7",
+                        heartbeat_timeout_ms=20, run_dir=da)
+    ra = run_simulation(cfg_a, printer=_quiet())
+    rb = run_simulation(Config(**BASE).validate(), printer=_quiet())
+    assert ra.converged
+    assert ra.stats.to_dict() == rb.stats.to_dict()
+    doc = json.load(open(os.path.join(da, "hostloss.json")))
+    assert [r["cause"] for r in doc["recoveries"]] == ["heartbeat"]
+    assert doc["heartbeat"]["lag_windows"] == 2  # 20ms / 10ms windows
+
+
+def test_supervisor_off_sidecars_unchanged(tmp_path):
+    """Supervisor-off pin: a plain checkpointing run writes sidecars with
+    NO provenance keys -- byte-layout identical to pre-PR snapshots -- and
+    its result carries no hostloss accounting."""
+    rd = str(tmp_path / "run")
+    cfg = Config(**BASE, checkpoint_every=2,
+                 checkpoint_dir=str(tmp_path / "ck"),
+                 run_dir=rd).validate()
+    res = run_simulation(cfg, printer=_quiet())
+    assert res.converged
+    path = checkpoint.latest(str(tmp_path / "ck"))
+    meta = json.load(open(path + ".json"))
+    assert "run_id" not in meta and "epoch" not in meta
+    doc = json.load(open(os.path.join(rd, "result.json")))
+    assert "recovered_windows" not in doc
+    assert not os.path.exists(os.path.join(rd, "hostloss.json"))
+
+
+# --------------------------------------------------------------------------
+# Scenario interop: host loss mid-churn with healing on
+# --------------------------------------------------------------------------
+
+# Churn + crash timeline that starts AFTER the oneshot injection at t=0:
+# the PR-4 CHURN_SCENARIO churns from t=0, which can take a rumor's seed
+# offline at injection and strand that rumor at zero coverage forever --
+# for a drill that must CONVERGE, the faults begin once every wave exists.
+CHURN = ('{"groups": 2, "downtime": 40, "events": ['
+         '{"type": "churn", "start": 30, "end": 120, "rate": 2.0},'
+         '{"type": "crash", "at": 50, "frac": 0.2, "group": 1}]}')
+
+
+def test_kill_drill_mid_churn_with_healing(tmp_path):
+    """Lose a host in the middle of the churn window with -overlay-heal
+    on: the snapshot carries scenario + heal state (the serve reshard
+    tests pin that), so the recovered run still reaches the coverage
+    target for every rumor with repairs counted.  The drill fires at
+    window 5 (= 50ms) -- churn is active and the group-1 crash lands that
+    same window, so recovery happens while the overlay is mid-repair."""
+    cfg = Config(n=1600, graph="kout", fanout=6, seed=3, crashrate=0.0,
+                 delaylow=10, delayhigh=11,
+                 coverage_target=0.99, max_rounds=600, scenario=CHURN,
+                 overlay_heal="on", backend="jax", engine="event",
+                 rumors=8, traffic="oneshot",
+                 supervise=True, workers=2, chaos="kill-worker@1:5",
+                 checkpoint_every=2, checkpoint_dir=str(tmp_path),
+                 progress=False).validate()
+    res = run_simulation(cfg, printer=_quiet())
+    assert res.converged, res.stats
+    assert res.stats.coverage >= 0.99
+    assert res.stats.rumors_done == 8
+    assert res.stats.shed == 0
+    assert res.stats.heal_repaired > 0
+    assert res.recovered_windows and res.recovered_windows > 0
+
+
+# --------------------------------------------------------------------------
+# Provenance guard (satellite 2)
+# --------------------------------------------------------------------------
+
+def test_verify_provenance_unit():
+    ok = {"run_id": "abc", "window": 10}
+    checkpoint.verify_provenance(ok, "p", run_id="abc", now_window=12,
+                                 max_stale=5)
+    # Empty run_id (plain -resume) and pre-provenance sidecars both pass
+    # the run check.
+    checkpoint.verify_provenance(ok, "p", run_id="", now_window=0)
+    checkpoint.verify_provenance({"window": 3}, "p", run_id="abc",
+                                 now_window=0)
+    with pytest.raises(ValueError, match="written by run abc"):
+        checkpoint.verify_provenance(ok, "p", run_id="xyz", now_window=0)
+    with pytest.raises(ValueError, match="recover-max-stale"):
+        checkpoint.verify_provenance(ok, "p", run_id="abc", now_window=16,
+                                     max_stale=5)
+    # max_stale=0 disables the staleness gate.
+    checkpoint.verify_provenance(ok, "p", run_id="abc", now_window=99)
+
+
+def _seed_snapshot(ck_dir, window, run_id):
+    return checkpoint.save(str(ck_dir), window,
+                           {"x": np.zeros(4, np.int32)}, Stats(n=4),
+                           extra_meta={"run_id": run_id})
+
+
+def test_recovery_refuses_foreign_snapshot(tmp_path):
+    """A snapshot from a DIFFERENT run sitting in the checkpoint dir is
+    refused by name at recovery -- a survivor must not silently resurrect
+    someone else's state.  checkpoint_every=50 keeps this run from
+    writing its own snapshot before the drill."""
+    ck = tmp_path / "ckpt"
+    _seed_snapshot(ck, 1, "someoneelse")
+    cfg = Config(**BASE, supervise=True, workers=2, run_id="mine",
+                 chaos="kill-worker@1:3", checkpoint_every=50,
+                 checkpoint_dir=str(ck)).validate()
+    with pytest.raises(ValueError, match="written by run someoneelse"):
+        run_simulation(cfg, printer=_quiet())
+
+
+def test_recovery_refuses_stale_snapshot(tmp_path):
+    """-recover-max-stale 1 with a snapshot 3 windows behind the loss:
+    refused by name (cadence 4, loss at window 7)."""
+    cfg = _supervised(BASE, tmp_path, chaos="kill-worker@1:7",
+                      checkpoint_every=4, recover_max_stale=1)
+    with pytest.raises(ValueError, match="recover-max-stale"):
+        run_simulation(cfg, printer=_quiet())
+
+
+def test_recovery_refuses_corrupted_snapshot(tmp_path):
+    """A truncated snapshot fails the sha256 sidecar check inside the
+    recovery path -- named "corrupt", never restored."""
+    ck = tmp_path / "ckpt"
+    path = _seed_snapshot(ck, 1, "mine")
+    with open(path, "r+b") as f:
+        f.truncate(16)
+    cfg = Config(**BASE, supervise=True, workers=2, run_id="mine",
+                 chaos="kill-worker@1:3", checkpoint_every=50,
+                 checkpoint_dir=str(ck)).validate()
+    with pytest.raises(ValueError, match="corrupt"):
+        run_simulation(cfg, printer=_quiet())
+
+
+def test_resume_respects_explicit_run_id(tmp_path):
+    """Plain -resume with an explicit -run-id refuses a foreign snapshot
+    (the relaunched-survivor path would otherwise adopt anything)."""
+    ck = tmp_path / "ckpt"
+    _seed_snapshot(ck, 1, "theirs")
+    cfg = Config(**BASE, resume=True, run_id="mine",
+                 checkpoint_dir=str(ck)).validate()
+    with pytest.raises(ValueError, match="written by run theirs"):
+        run_simulation(cfg, printer=_quiet())
+
+
+# --------------------------------------------------------------------------
+# Bounded jax.distributed.initialize (satellite 1)
+# --------------------------------------------------------------------------
+
+def test_bounded_initialize_names_failure(monkeypatch):
+    calls = []
+
+    def boom(**kw):
+        calls.append(kw)
+        raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    sleeps = []
+    with pytest.raises(DistributedInitError) as ei:
+        bounded_initialize(coordinator_address="badhost:1", num_processes=2,
+                           process_id=0, timeout_s=5, retries=3,
+                           base_delay_s=0.01, _sleep=sleeps.append)
+    msg = str(ei.value)
+    assert "badhost:1" in msg and "3 attempt" in msg
+    assert "connection refused" in msg
+    assert len(calls) == 3
+    assert sleeps == [0.01, 0.02]  # exponential backoff between attempts
+
+
+def test_bounded_initialize_passes_timeout_kwarg(monkeypatch):
+    captured = {}
+
+    def fake(coordinator_address=None, num_processes=None, process_id=None,
+             initialization_timeout=None):
+        captured.update(coordinator_address=coordinator_address,
+                        initialization_timeout=initialization_timeout)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake)
+    elapsed = bounded_initialize(coordinator_address="h:1", timeout_s=7)
+    assert elapsed >= 0
+    assert captured["coordinator_address"] == "h:1"
+    assert captured["initialization_timeout"] == 7
+
+
+# --------------------------------------------------------------------------
+# The real two-process SIGKILL drill (capability-probed)
+# --------------------------------------------------------------------------
+
+@needs_multiprocess
+def test_real_supervisor_survives_sigkill(tmp_path):
+    """End to end through the CLI: the supervisor spawns two
+    jax.distributed workers (4 fake devices each), SIGKILLs worker 1 at
+    window 4 via the -chaos drill, relaunches the survivor with -resume
+    on the shared snapshot, and the run still converges -- exit 0, the
+    recovery accounted in supervisor.json."""
+    from gossip_simulator_tpu.utils.jaxsetup import forced_cpu_env
+
+    ck, rd = str(tmp_path / "ckpt"), str(tmp_path / "run")
+    args = [sys.executable, "-m", "gossip_simulator_tpu",
+            "-n", "2048", "-graph", "kout", "-fanout", "6", "-seed", "3",
+            "-crashrate", "0", "-droprate", "0",
+            "-delaylow", "10", "-delayhigh", "11",
+            "-backend", "sharded", "-engine", "event",
+            "-rumors", "8", "-traffic", "stream", "-stream-rate", "40",
+            "-coverage-target", "0.99", "-quiet",
+            "-supervise", "-workers", "2",
+            "-coordinator", f"localhost:{_free_port()}",
+            "-chaos", "kill-worker@1:4",
+            "-checkpoint-every", "2", "-checkpoint-dir", ck,
+            "-run-dir", rd]
+    proc = subprocess.Popen(args, env=forced_cpu_env(4),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        pytest.fail("supervised run timed out")
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{out}\n{err}"
+    sup = json.load(open(os.path.join(rd, "supervisor.json")))
+    assert sup["exit_code"] == 0
+    assert len(sup["recoveries"]) == 1
+    assert sup["recoveries"][0]["workers_lost"] == [1]
+    assert sup["recovered_windows"] >= 0
+    assert sup["recovery_pause_ms"] > 0
+    assert sup["final_processes"] == 1
+    res = json.load(open(os.path.join(rd, "result.json")))
+    assert res["converged"] is True
